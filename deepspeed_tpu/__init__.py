@@ -1,0 +1,100 @@
+"""DeepSpeed-TPU: a TPU-native training & inference framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capability set of DeepSpeed
+(reference ``deepspeed/__init__.py``): ``initialize()`` brings up a device
+mesh and returns an engine with forward/backward/step and checkpoint APIs;
+ZeRO stages map to parameter/gradient/optimizer-state sharding over the mesh's
+data axes; pipeline/tensor/sequence/expert parallelism ride named mesh axes
+with XLA collectives over ICI/DCN.
+"""
+
+__version__ = "0.1.0"
+version = __version__
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401
+from .comm.comm import init_distributed  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .utils import logger  # noqa: F401
+
+
+def initialize(args=None,
+               model=None,
+               optimizer=None,
+               model_parameters=None,
+               training_data=None,
+               lr_scheduler=None,
+               distributed_port=29500,
+               mpu=None,
+               dist_init_required=None,
+               collate_fn=None,
+               config=None,
+               mesh_param=None,
+               config_params=None):
+    """Initialize the DeepSpeed-TPU engine. Analog of ``deepspeed/__init__.py:69``.
+
+    Arguments:
+        model: a model definition — any object exposing ``init(rng, *batch)``
+            and ``apply(params, *batch)`` (see ``deepspeed_tpu.models``), or a
+            flax ``nn.Module`` (adapted automatically), or a ready param pytree
+            paired with an apply function via ``models.FunctionalModel``.
+        optimizer: optional optimizer name/instance overriding the config.
+        config: DeepSpeed-style JSON config (dict, path, or JSON string).
+
+    Returns: tuple of ``engine, optimizer, training_dataloader, lr_scheduler``
+    """
+    from .runtime.engine import DeepSpeedEngine
+
+    if config is None:
+        config = config_params
+    if config is None and args is not None:
+        config = getattr(args, "deepspeed_config", None)
+    assert model is not None, "deepspeed_tpu.initialize requires a model"
+
+    init_distributed(distributed_port=distributed_port, verbose=False,
+                     mesh_config=None if config is None else DeepSpeedConfig(config).mesh)
+
+    engine = DeepSpeedEngine(args=args,
+                             model=model,
+                             optimizer=optimizer,
+                             model_parameters=model_parameters,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler,
+                             mpu=mpu,
+                             collate_fn=collate_fn,
+                             config=config)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def init_inference(model=None, config=None, **kwargs):
+    """Initialize an inference engine. Analog of ``deepspeed/__init__.py:291``."""
+    from .inference.config import DeepSpeedInferenceConfig
+    from .inference.engine import InferenceEngine
+
+    if config is None:
+        config = {}
+    if isinstance(config, dict):
+        config.update(kwargs)
+        config = DeepSpeedInferenceConfig(**config)
+    return InferenceEngine(model, config)
+
+
+def default_inference_config():
+    from .inference.config import DeepSpeedInferenceConfig
+    return DeepSpeedInferenceConfig().model_dump()
+
+
+def add_config_arguments(parser):
+    """Add --deepspeed / --deepspeed_config CLI args. Analog of ``__init__.py:268``."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag to ease transition)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed-TPU json configuration file")
+    group.add_argument("--deepscale", default=False, action="store_true", help=argparse_suppress())
+    return parser
+
+
+def argparse_suppress():
+    import argparse
+    return argparse.SUPPRESS

@@ -1,0 +1,165 @@
+"""Accelerator abstraction.
+
+Analog of ``accelerator/abstract_accelerator.py:10`` (DeepSpeedAccelerator
+ABC). The reference's ~70 abstract methods are torch-device-centric
+(streams/events/caching allocator); on JAX the runtime owns those, so the
+surface here keeps the portable subset: device identity/count, memory stats,
+RNG, dtype support, communication backend name, and op-builder namespace
+selection. Streams/events collapse to XLA's async dispatch: ``Stream`` is a
+no-op context and ``Event`` records via ``block_until_ready`` fences.
+"""
+
+import abc
+from contextlib import contextmanager
+
+
+class DeepSpeedAccelerator(abc.ABC):
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ---- device APIs ----
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None) -> str:
+        ...
+
+    @abc.abstractmethod
+    def device_count(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def devices(self):
+        ...
+
+    def current_device(self):
+        return 0
+
+    def current_device_name(self):
+        return self.device_name(self.current_device())
+
+    def set_device(self, device_index):
+        ...
+
+    def synchronize(self, device_index=None):
+        import jax
+        try:
+            import jax.numpy as jnp
+            jax.block_until_ready(jnp.zeros(()))
+        except Exception:
+            pass
+
+    # ---- RNG ----
+    def manual_seed(self, seed):
+        import jax
+        return jax.random.PRNGKey(seed)
+
+    def initial_seed(self):
+        return 0
+
+    # ---- streams/events: XLA dispatch is already async ----
+    @contextmanager
+    def stream(self, stream=None):
+        yield
+
+    def Stream(self, *args, **kwargs):
+        return None
+
+    def Event(self, *args, **kwargs):
+        return None
+
+    def default_stream(self):
+        return None
+
+    def current_stream(self):
+        return None
+
+    # ---- memory ----
+    @abc.abstractmethod
+    def memory_stats(self, device_index=None) -> dict:
+        ...
+
+    def memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self.memory_stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self.memory_stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        stats = self.memory_stats(device_index)
+        return stats.get("bytes_limit", 0) - stats.get("bytes_in_use", 0)
+
+    def empty_cache(self):
+        ...
+
+    def reset_peak_memory_stats(self, device_index=None):
+        ...
+
+    # ---- dtype support ----
+    @abc.abstractmethod
+    def is_bf16_supported(self) -> bool:
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self) -> bool:
+        ...
+
+    def is_fp8_supported(self) -> bool:
+        return False
+
+    def supported_dtypes(self):
+        import jax.numpy as jnp
+        dtypes = [jnp.float32]
+        if self.is_fp16_supported():
+            dtypes.append(jnp.float16)
+        if self.is_bf16_supported():
+            dtypes.append(jnp.bfloat16)
+        if self.is_fp8_supported():
+            dtypes.append(jnp.float8_e4m3fn)
+        return dtypes
+
+    # ---- misc ----
+    @abc.abstractmethod
+    def communication_backend_name(self) -> str:
+        ...
+
+    def is_triton_supported(self) -> bool:
+        return False
+
+    def use_host_timers(self) -> bool:
+        return True
+
+    # ---- graph capture: jit IS the graph on XLA ----
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, **kwargs):
+        return _nullcontext()
+
+    def replay_graph(self, graph):
+        ...
+
+    # ---- op builder namespace ----
+    @abc.abstractmethod
+    def op_builder_dir(self) -> str:
+        ...
+
+    @abc.abstractmethod
+    def create_op_builder(self, class_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, class_name):
+        ...
+
+
+@contextmanager
+def _nullcontext():
+    yield

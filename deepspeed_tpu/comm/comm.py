@@ -1,0 +1,256 @@
+"""Module-level communication API.
+
+Analog of ``deepspeed/comm/comm.py``: module-level collectives + init, with
+the ``timed_op`` profiling wrapper and ``log_summary`` (reference
+``comm/comm.py:101,422``). Backed by :class:`XlaBackend` (eager, host-level)
+— in-trace code should use the functions re-exported from ``backend`` (psum,
+all_gather, ...) inside ``shard_map``.
+"""
+
+import functools
+import os
+import time
+from typing import Optional
+
+import jax
+
+from ..utils import groups
+from ..utils.logging import logger
+from .backend import ReduceOp, XlaBackend
+from .backend import (all_gather, all_to_all, pmax, pmean, ppermute, psum,  # noqa: F401 (in-trace API)
+                      psum_scatter, ring_send_recv)
+
+cdb: Optional[XlaBackend] = None  # "communication data backend" — name kept from reference
+comms_logger = None
+timers = None
+
+
+class CommsConfig:
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.debug = False
+        self.prof_all = True
+        self.prof_ops = []
+
+
+class CommsLogger:
+    """Records per-op counts/sizes/latencies. Analog of utils/comms_logging.py."""
+
+    def __init__(self):
+        self.comms_dict = {}
+        self.verbose = False
+        self.debug = False
+        self.prof_ops = []
+        self.prof_all = True
+        self.enabled = False
+
+    def configure(self, comms_config):
+        self.enabled = comms_config.enabled
+        if self.enabled:
+            self.verbose = comms_config.verbose
+            self.debug = comms_config.debug
+            self.prof_ops = comms_config.prof_ops
+            self.prof_all = comms_config.prof_all
+
+    def start_profiling_comms(self):
+        self.prof_all = True
+
+    def stop_profiling_comms(self):
+        self.prof_all = False
+
+    def append(self, raw_name, record_name, latency, msg_size):
+        algbw = (msg_size / latency) / 1e9 if latency > 0 else 0.0
+        if record_name in self.comms_dict:
+            if msg_size in self.comms_dict[record_name]:
+                self.comms_dict[record_name][msg_size][0] += 1
+                self.comms_dict[record_name][msg_size][1].append(latency)
+                self.comms_dict[record_name][msg_size][2].append(algbw)
+            else:
+                self.comms_dict[record_name][msg_size] = [1, [latency], [algbw]]
+        else:
+            self.comms_dict[record_name] = {msg_size: [1, [latency], [algbw]]}
+        if self.verbose:
+            logger.info(f"comm op: {record_name} | time (ms): {latency * 1000:.2f} | msg size: {msg_size} | "
+                        f"algbw (GB/s): {algbw:.2f}")
+
+    def log_all(self, print_log=True, show_straggler=False):
+        import numpy as np
+        output = ["Comm. Op    Message Size    Count    Total Latency(ms)    Avg Latency(ms)    algbw(GB/s)"]
+        for record_name in self.comms_dict:
+            for msg_size, vals in sorted(self.comms_dict[record_name].items()):
+                count, latencies, algbws = vals
+                output.append(f"{record_name:<12}{msg_size:<16}{count:<9}{sum(latencies)*1000:<21.2f}"
+                              f"{np.mean(latencies)*1000:<19.2f}{np.mean(algbws):<.2f}")
+        text = "\n".join(output)
+        if print_log:
+            logger.info("\n" + text)
+        return text
+
+
+def _msg_size(tensor):
+    try:
+        return tensor.size * tensor.dtype.itemsize
+    except Exception:
+        return 0
+
+
+def timed_op(func):
+    """Wrap an eager collective with wall-clock + message-size profiling."""
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        global comms_logger
+        prof = comms_logger is not None and comms_logger.enabled and (comms_logger.prof_all
+                                                                      or func.__name__ in comms_logger.prof_ops)
+        if not prof:
+            return func(*args, **kwargs)
+        tensor = args[0] if args else kwargs.get("tensor")
+        start = time.perf_counter()
+        result = func(*args, **kwargs)
+        jax.block_until_ready(result) if result is not None else None
+        latency = time.perf_counter() - start
+        comms_logger.append(func.__name__, func.__name__, latency, _msg_size(tensor))
+        return result
+
+    return wrapper
+
+
+def init_distributed(dist_backend="xla",
+                     auto_mpi_discovery=True,
+                     distributed_port=29500,
+                     verbose=True,
+                     timeout=None,
+                     init_method=None,
+                     dist_init_required=None,
+                     config=None,
+                     rank=-1,
+                     world_size=-1,
+                     mesh_config=None):
+    """Bring up the (multi-host) runtime and the global device mesh.
+
+    Analog of ``deepspeed/comm/comm.py:619``. Single-host: no-op rendezvous.
+    Multi-host: ``jax.distributed.initialize`` (TPU pods auto-discover via the
+    metadata server, so coordinator args are optional there).
+    """
+    global cdb, comms_logger
+    if cdb is not None and cdb.initialized:
+        return cdb
+    cdb = XlaBackend()
+
+    coordinator = os.environ.get("MASTER_ADDR")
+    n_proc = int(os.environ.get("WORLD_SIZE", world_size if world_size > 0 else 1))
+    proc_id = int(os.environ.get("RANK", rank if rank >= 0 else 0))
+    if n_proc > 1 and jax.process_count() == 1:
+        addr = f"{coordinator}:{distributed_port}" if coordinator else None
+        cdb.init_process_group(coordinator_address=addr, num_processes=n_proc, process_id=proc_id)
+    else:
+        cdb.init_process_group()
+
+    if not groups.mesh_is_initialized():
+        groups.set_mesh(groups.build_mesh(mesh_config=mesh_config))
+    if comms_logger is None:
+        comms_logger = CommsLogger()
+    if verbose:
+        mesh = groups.get_mesh()
+        logger.info(f"Initialized distributed: processes={jax.process_count()} devices={jax.device_count()} "
+                    f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}")
+    return cdb
+
+
+def initialize_mesh_device(mesh_shape, mesh_axis_names=None):
+    """Analog of ``comm/comm.py:603`` — explicit mesh construction."""
+    import numpy as np
+    from jax.sharding import Mesh
+    devices = np.asarray(jax.devices()).reshape(mesh_shape)
+    mesh = Mesh(devices, mesh_axis_names or groups.MESH_AXIS_ORDER[:len(mesh_shape)])
+    groups.set_mesh(mesh)
+    return mesh
+
+
+def is_initialized():
+    return cdb is not None and cdb.initialized
+
+
+def _ensure_backend():
+    global cdb
+    if cdb is None or not cdb.initialized:
+        init_distributed(verbose=False)
+    return cdb
+
+
+def get_rank(group=None):
+    return _ensure_backend().rank()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        import math
+        mesh = groups.get_mesh()
+        axes = (group,) if isinstance(group, str) else tuple(group)
+        return math.prod(mesh.shape[a] for a in axes)
+    return jax.device_count()
+
+
+def get_local_rank():
+    return int(os.environ.get("LOCAL_RANK", 0))
+
+
+def configure(deepspeed_config=None, enabled=None, prof_all=None, prof_ops=None, verbose=None, debug=None):
+    global comms_logger
+    if comms_logger is None:
+        comms_logger = CommsLogger()
+    cfg = CommsConfig()
+    if deepspeed_config is not None:
+        cl = deepspeed_config.comms_logger
+        cfg.enabled, cfg.verbose, cfg.prof_all, cfg.debug, cfg.prof_ops = (cl.enabled, cl.verbose, cl.prof_all,
+                                                                           cl.debug, cl.prof_ops)
+    for name, val in (("enabled", enabled), ("prof_all", prof_all), ("prof_ops", prof_ops), ("verbose", verbose),
+                      ("debug", debug)):
+        if val is not None:
+            setattr(cfg, name, val)
+    comms_logger.configure(cfg)
+
+
+def log_summary(show_straggler=False):
+    global comms_logger
+    if comms_logger is not None:
+        return comms_logger.log_all(show_straggler=show_straggler)
+
+
+# ---- eager collectives (host-level / benchmarking) ----
+
+@timed_op
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False):
+    return _ensure_backend().all_reduce(tensor, op=op, group=group)
+
+
+@timed_op
+def all_gather_into_tensor(tensor, group=None, async_op=False):
+    return _ensure_backend().all_gather_into_tensor(tensor, group=group)
+
+
+@timed_op
+def reduce_scatter_tensor(tensor, op=ReduceOp.SUM, group=None, async_op=False):
+    return _ensure_backend().reduce_scatter_tensor(tensor, op=op, group=group)
+
+
+@timed_op
+def all_to_all_single(tensor, scatter_dim=0, gather_dim=0, group=None, async_op=False):
+    return _ensure_backend().all_to_all_single(tensor, scatter_dim=scatter_dim, gather_dim=gather_dim, group=group)
+
+
+@timed_op
+def broadcast(tensor, src=0, group=None, async_op=False):
+    return _ensure_backend().broadcast(tensor, src=src, group=group)
+
+
+def barrier(group=None):
+    _ensure_backend().barrier(group=group)
+
+
+def destroy_process_group():
+    global cdb
+    if cdb is not None:
+        cdb.destroy_process_group()
+        cdb = None

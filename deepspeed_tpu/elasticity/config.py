@@ -1,0 +1,87 @@
+"""Elasticity config. Analog of ``deepspeed/elasticity/config.py``."""
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+LATEST_ELASTICITY_VERSION = 0.2
+MINIMUM_DEEPSPEED_VERSION = "0.3.8"
+
+
+class ElasticityConfig:
+    """Controls elastic batch-size/device-count co-design.
+
+    {
+      "elasticity": {
+        "enabled": true,
+        "max_train_batch_size": 2000,
+        "micro_batch_sizes": [2,4,6],
+        "min_gpus": 1, "max_gpus": 10000,
+        "min_time": 20,
+        "prefer_larger_batch": true,
+        "ignore_non_elastic_batch_info": false,
+        "version": 0.1
+      }
+    }
+    """
+
+    def __init__(self, param_dict):
+        self.enabled = param_dict.get("enabled", False)
+        if "max_train_batch_size" in param_dict:
+            self.max_acceptable_batch_size = param_dict["max_train_batch_size"]
+        else:
+            raise ElasticityConfigError("Elasticity config missing max_train_batch_size")
+        if "micro_batch_sizes" in param_dict:
+            self.micro_batches = param_dict["micro_batch_sizes"]
+        else:
+            raise ElasticityConfigError("Elasticity config missing micro_batch_sizes")
+        if not isinstance(self.micro_batches, list):
+            raise ElasticityConfigError(
+                f"Elasticity expected value of micro_batch_sizes to be a list of micro batches, "
+                f"instead is: {type(self.micro_batches)}, containing: {self.micro_batches}")
+        if not all(isinstance(m, int) for m in self.micro_batches):
+            raise ElasticityConfigError(f"Elasticity expected micro_batch_sizes to only contain ints, "
+                                        f"instead contains: {self.micro_batches}")
+        if not all(m > 0 for m in self.micro_batches):
+            raise ElasticityConfigError(f"Elasticity expected micro_batch_sizes to only contain positive ints, "
+                                        f"instead contains: {self.micro_batches}")
+        self.min_gpus = param_dict.get("min_gpus", 1)
+        self.max_gpus = param_dict.get("max_gpus", 10000)
+        if self.min_gpus < 1 or self.max_gpus < 1:
+            raise ElasticityConfigError("Elasticity min/max gpus must be > 0, "
+                                        f"given min_gpus: {self.min_gpus}, max_gpus: {self.max_gpus}")
+        if self.max_gpus < self.min_gpus:
+            raise ElasticityConfigError("Elasticity min_gpus cannot be greater than max_gpus, "
+                                        f"given min_gpus: {self.min_gpus}, max_gpus: {self.max_gpus}")
+        self.model_parallel_size = param_dict.get("model_parallel_size", 1)
+        if self.model_parallel_size < 1:
+            raise ElasticityConfigError("Model-Parallel size cannot be less than 1, "
+                                        f"given model-parallel size: {self.model_parallel_size}")
+        self.num_gpus_per_node = param_dict.get("num_gpus_per_node", 1)
+        if self.num_gpus_per_node < 1:
+            raise ElasticityConfigError("Number of GPUs per node cannot be less than 1, "
+                                        f"given number of GPUs per node: {self.num_gpus_per_node}")
+        self.min_time = param_dict.get("min_time", 0)
+        self.version = param_dict.get("version", LATEST_ELASTICITY_VERSION)
+        self.prefer_larger_batch_size = param_dict.get("prefer_larger_batch", True)
+        self.ignore_non_elastic_batch_info = param_dict.get("ignore_non_elastic_batch_info", False)
+
+    def repr(self):
+        return self.__dict__
+
+    def __repr__(self):
+        return json_repr(self.__dict__)
+
+
+def json_repr(d):
+    import json
+    return json.dumps(d, indent=2, default=str)

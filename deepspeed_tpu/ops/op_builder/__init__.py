@@ -1,0 +1,85 @@
+"""Op builder registry. Analog of ``op_builder/__init__.py`` ALL_OPS table."""
+
+from .builder import NativeOpBuilder, OpBuilder, PallasOpBuilder
+
+
+class FusedAdamBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("fused_adam", "deepspeed_tpu.ops.pallas.fused_adam")
+
+
+class FlashAttnBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("flash_attn", "deepspeed_tpu.ops.pallas.flash_attention")
+
+
+class PagedAttnBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("paged_attn", "deepspeed_tpu.ops.pallas.paged_attention")
+
+
+class QuantizerBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("quantizer", "deepspeed_tpu.ops.pallas.quantizer")
+
+
+class FPQuantizerBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("fp_quantizer", "deepspeed_tpu.ops.pallas.fp_quantizer")
+
+
+class GroupedGemmBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("grouped_gemm", "deepspeed_tpu.ops.pallas.grouped_gemm")
+
+
+class RingAttnBuilder(PallasOpBuilder):
+    def __init__(self):
+        super().__init__("ring_attn", "deepspeed_tpu.ops.pallas.ring_attention")
+
+
+class CPUAdamBuilder(NativeOpBuilder):
+    """AVX-vectorized host Adam for ZeRO-Offload (reference csrc/adam/cpu_adam.cpp)."""
+
+    def __init__(self):
+        super().__init__("cpu_adam")
+
+    def sources(self):
+        return ["csrc/cpu_adam.cpp"]
+
+    def include_paths(self):
+        return ["csrc"]
+
+    def cxx_args(self):
+        import platform
+        args = ["-O3", "-std=c++17", "-fPIC", "-fopenmp", "-g"]
+        if platform.machine() == "x86_64":
+            args += ["-march=native"]
+        return args
+
+
+class AsyncIOBuilder(NativeOpBuilder):
+    """Async NVMe/file IO engine (reference csrc/aio)."""
+
+    def __init__(self):
+        super().__init__("async_io")
+
+    def sources(self):
+        return ["csrc/aio.cpp"]
+
+    def include_paths(self):
+        return ["csrc"]
+
+    def extra_ldflags(self):
+        return ["-lpthread"]
+
+
+ALL_OPS = {
+    cls.__name__: cls
+    for cls in [
+        FusedAdamBuilder, FlashAttnBuilder, PagedAttnBuilder, QuantizerBuilder, FPQuantizerBuilder,
+        GroupedGemmBuilder, RingAttnBuilder, CPUAdamBuilder, AsyncIOBuilder
+    ]
+}
+
+__all__ = ["OpBuilder", "PallasOpBuilder", "NativeOpBuilder", "ALL_OPS"] + list(ALL_OPS.keys())

@@ -1,0 +1,145 @@
+"""Op builder framework.
+
+Analog of ``op_builder/builder.py:109`` (OpBuilder: sources/load/jit_load/
+is_compatible). Two TPU-native builder families:
+
+- :class:`PallasOpBuilder` — "building" is importing a Python module of Pallas
+  kernels (compiled lazily by XLA at first trace); ``is_compatible`` probes the
+  backend (TPU vs CPU-interpret mode).
+- :class:`NativeOpBuilder` — compiles C++ host code (CPU Adam, async IO) with
+  g++ into a shared library loaded via ctypes; this is the analog of the
+  reference's torch cpp_extension JIT path (``builder.py:532 jit_load``).
+"""
+
+import importlib
+import os
+import shutil
+import subprocess
+import sys
+import time
+from abc import ABC, abstractmethod
+
+from ...utils.logging import logger
+
+
+class OpBuilder(ABC):
+
+    def __init__(self, name):
+        self.name = name
+        self.jit_mode = False
+        self.error_log = None
+
+    @abstractmethod
+    def absolute_name(self):
+        """Importable module name of the built op, e.g. deepspeed_tpu.ops.pallas.fused_adam"""
+        ...
+
+    def sources(self):
+        return []
+
+    def include_paths(self):
+        return []
+
+    def is_compatible(self, verbose=False):
+        return True
+
+    def extra_ldflags(self):
+        return []
+
+    def cxx_args(self):
+        return ["-O3", "-std=c++17", "-fPIC", "-fopenmp"]
+
+    def load(self, verbose=True):
+        return self.jit_load(verbose=verbose)
+
+    @abstractmethod
+    def jit_load(self, verbose=True):
+        ...
+
+    def command_exists(self, cmd):
+        return shutil.which(cmd) is not None
+
+
+class PallasOpBuilder(OpBuilder):
+    """Builder whose artifact is a Python module of Pallas/XLA kernels."""
+
+    def __init__(self, name, module):
+        super().__init__(name)
+        self.module = module
+
+    def absolute_name(self):
+        return self.module
+
+    def is_compatible(self, verbose=False):
+        try:
+            importlib.import_module(self.module)
+            return True
+        except Exception as e:
+            if verbose:
+                logger.warning(f"op {self.name} incompatible: {e}")
+            self.error_log = str(e)
+            return False
+
+    def jit_load(self, verbose=True):
+        start = time.time()
+        mod = importlib.import_module(self.module)
+        if verbose:
+            logger.info(f"Loading op {self.name} took {time.time() - start:.3f} seconds")
+        return mod
+
+
+def _repo_root():
+    return os.path.abspath(os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+class NativeOpBuilder(OpBuilder):
+    """g++-compiled host extension, loaded via ctypes.
+
+    Build artifacts land in ``~/.cache/deepspeed_tpu/<name>/`` (analog of
+    TORCH_EXTENSIONS_DIR).
+    """
+
+    BUILD_ROOT = os.environ.get("DS_TPU_BUILD_DIR", os.path.expanduser("~/.cache/deepspeed_tpu"))
+
+    def __init__(self, name):
+        super().__init__(name)
+
+    def absolute_name(self):
+        return f"deepspeed_tpu.ops.native.{self.name}"
+
+    def lib_path(self):
+        return os.path.join(self.BUILD_ROOT, self.name, f"lib{self.name}.so")
+
+    def is_compatible(self, verbose=False):
+        if not self.command_exists("g++"):
+            self.error_log = "g++ not found"
+            return False
+        return True
+
+    def _needs_rebuild(self):
+        lib = self.lib_path()
+        if not os.path.exists(lib):
+            return True
+        lib_mtime = os.path.getmtime(lib)
+        return any(os.path.getmtime(src) > lib_mtime for src in self.sources())
+
+    def jit_load(self, verbose=True):
+        import ctypes
+        if self._needs_rebuild():
+            start = time.time()
+            os.makedirs(os.path.dirname(self.lib_path()), exist_ok=True)
+            srcs = [os.path.join(_repo_root(), s) if not os.path.isabs(s) else s for s in self.sources()]
+            incs = [f"-I{os.path.join(_repo_root(), i) if not os.path.isabs(i) else i}" for i in self.include_paths()]
+            cmd = ["g++", "-shared", *self.cxx_args(), *incs, *srcs, "-o", self.lib_path(), *self.extra_ldflags()]
+            if verbose:
+                logger.info(f"Building op {self.name}: {' '.join(cmd)}")
+            result = subprocess.run(cmd, capture_output=True, text=True)
+            if result.returncode != 0:
+                self.error_log = result.stderr
+                raise RuntimeError(f"Failed to build {self.name}:\n{result.stderr}")
+            if verbose:
+                logger.info(f"Time to build op {self.name}: {time.time() - start:.3f} seconds")
+        return ctypes.CDLL(self.lib_path())
+
+    def sources(self):
+        return []

@@ -1,0 +1,289 @@
+"""Top-level config system.
+
+Analog of ``deepspeed/runtime/config.py:706`` (DeepSpeedConfig): a single JSON
+dict (or path) gates every subsystem. Field names match the reference so
+existing DeepSpeed configs parse unchanged; a TPU-specific ``mesh`` block adds
+device-mesh axis sizes (data/fsdp/tensor/pipe/seq/expert).
+
+Batch-size resolution (train_batch_size = micro_batch * grad_accum * dp_world)
+follows ``config.py:979 _configure_train_batch_size``.
+"""
+
+import json
+import os
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from ..utils.logging import logger
+from .config_utils import DeepSpeedConfigModel, dict_raise_error_on_duplicate_keys
+from .constants import *  # noqa: F401,F403
+from .zero.config import DeepSpeedZeroConfig
+
+
+class DeepSpeedFP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = Field(0.0, ge=0.0)  # 0 → dynamic
+    initial_scale_power: int = Field(16, ge=0)
+    loss_scale_window: int = Field(1000, ge=0)
+    hysteresis: int = Field(2, ge=0)
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = Field(1.0, ge=0.0)
+    fp16_master_weights_and_grads: bool = False
+
+    @property
+    def dynamic_loss_scale(self):
+        return self.loss_scale == 0
+
+
+class DeepSpeedBF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    immediate_grad_update: bool = False
+
+
+class DeepSpeedOptimizerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = {}
+    legacy_fusion: bool = False
+
+
+class DeepSpeedSchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = {}
+
+
+class MeshConfig(DeepSpeedConfigModel):
+    """TPU device mesh layout. Any axis may be "auto" (resolved at init).
+
+    Axis order is (pipe, data, seq, expert_inner, tensor) — outer axes map to
+    DCN/slower links, inner axes to ICI, following the scaling-book recipe.
+    ``data`` doubles as the ZeRO/FSDP sharding axis (the reference shards ZeRO
+    state over the DP group the same way).
+    """
+    data: Union[int, str] = -1  # -1 → fill with remaining devices
+    tensor: int = Field(1, ge=1)
+    pipe: int = Field(1, ge=1)
+    seq: int = Field(1, ge=1)
+    expert: int = Field(1, ge=1)
+    # how many data-axis devices form one ICI slice (for hierarchical collectives)
+    replica_groups: int = Field(1, ge=1)
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: list = []
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native: jax.checkpoint policy name ("nothing", "dots", "dots_with_no_batch_dims", "everything")
+    policy: str = "nothing"
+
+
+class MonitorConfigBlock(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    # wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
+    tensorboard: MonitorConfigBlock = MonitorConfigBlock()
+    csv_monitor: MonitorConfigBlock = MonitorConfigBlock()
+    wandb: MonitorConfigBlock = MonitorConfigBlock()
+
+    @property
+    def enabled(self):
+        return self.tensorboard.enabled or self.csv_monitor.enabled or self.wandb.enabled
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = {}
+    # TPU-native: use orbax async checkpointing
+    async_save: bool = False
+
+
+class DataTypesConfig(DeepSpeedConfigModel):
+    grad_accum_dtype: Optional[str] = None
+
+
+class CompileConfig(DeepSpeedConfigModel):
+    """Analog of torch.compile block — under JAX everything is jitted; these
+    knobs control XLA compilation cache and donation."""
+    enabled: bool = True
+    cache_dir: Optional[str] = None
+    donate_params: bool = True
+
+
+def _to_dict(config: Union[str, dict, None]) -> dict:
+    if config is None:
+        return {}
+    if isinstance(config, dict):
+        return config
+    if isinstance(config, str):
+        if os.path.exists(config):
+            with open(config) as f:
+                return json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+        try:
+            return json.loads(config)
+        except json.JSONDecodeError:
+            raise ValueError(f"Expected a file path or JSON string for config, got: {config!r}")
+    raise TypeError(f"Unsupported config type: {type(config)}")
+
+
+class DeepSpeedConfig:
+    """Parsed, validated view over the user's JSON config dict."""
+
+    def __init__(self, config: Union[str, dict, None], world_size: Optional[int] = None, mesh=None):
+        self._param_dict = _to_dict(config)
+        d = self._param_dict
+
+        self.train_batch_size = d.get(TRAIN_BATCH_SIZE, TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = d.get(TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                                                    TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = d.get(GRADIENT_ACCUMULATION_STEPS, GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        for key in (TRAIN_BATCH_SIZE, TRAIN_MICRO_BATCH_SIZE_PER_GPU, GRADIENT_ACCUMULATION_STEPS):
+            if isinstance(d.get(key), str) and d[key] != "auto":
+                raise ValueError(f"{key} must be an integer or 'auto', got {d[key]!r}")
+
+        self.steps_per_print = d.get(STEPS_PER_PRINT, STEPS_PER_PRINT_DEFAULT)
+        self.wall_clock_breakdown = d.get(WALL_CLOCK_BREAKDOWN, WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.dump_state = d.get(DUMP_STATE, False)
+        self.prescale_gradients = d.get(PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor = d.get(GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.sparse_gradients_enabled = d.get(SPARSE_GRADIENTS, False)
+        self.gradient_clipping = d.get(GRADIENT_CLIPPING, GRADIENT_CLIPPING_DEFAULT)
+        self.communication_data_type = d.get(COMMUNICATION_DATA_TYPE, None)
+        self.seq_parallel_communication_data_type = d.get(SEQ_PARALLEL_COMMUNICATION_DATA_TYPE, None)
+        self.dataloader_drop_last = d.get(DATALOADER_DROP_LAST, DATALOADER_DROP_LAST_DEFAULT)
+
+        self.fp16 = DeepSpeedFP16Config(**d.get(FP16, {}))
+        bf16_dict = d.get(BFLOAT16, d.get(BFLOAT16_OLD, {}))
+        self.bf16 = DeepSpeedBF16Config(**bf16_dict)
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 modes cannot both be enabled")
+
+        opt = d.get(OPTIMIZER, None)
+        self.optimizer = DeepSpeedOptimizerConfig(**opt) if isinstance(opt, dict) else DeepSpeedOptimizerConfig()
+        sched = d.get(SCHEDULER, None)
+        self.scheduler = DeepSpeedSchedulerConfig(**sched) if isinstance(sched, dict) else DeepSpeedSchedulerConfig()
+
+        self.zero_config = DeepSpeedZeroConfig(**d.get(ZERO_OPTIMIZATION, {}))
+        self.mesh = MeshConfig(**d.get(MESH, {}))
+        self.flops_profiler = FlopsProfilerConfig(**d.get(FLOPS_PROFILER, {}))
+        self.comms_logger = CommsLoggerConfig(**d.get(COMMS_LOGGER, {}))
+        self.activation_checkpointing = ActivationCheckpointingConfig(**d.get(ACTIVATION_CHECKPOINTING, {}))
+        self.monitor_config = DeepSpeedMonitorConfig(
+            **{k: d[k] for k in (MONITOR_TENSORBOARD, MONITOR_CSV, MONITOR_WANDB) if k in d})
+        self.checkpoint_config = CheckpointConfig(**d.get(CHECKPOINT, {}))
+        self.data_types = DataTypesConfig(**d.get("data_types", {}))
+        self.compile_config = CompileConfig(**d.get("compile", {}))
+
+        from ..elasticity.config import ElasticityConfig
+        self.elasticity = ElasticityConfig(d.get(ELASTICITY, {})) if ELASTICITY in d else None
+        self.autotuning = d.get(AUTOTUNING, {})
+        self.compression = d.get(GRADIENT_COMPRESSION, {})
+        self.data_efficiency = d.get(DATA_EFFICIENCY, {})
+        self.curriculum_learning_legacy = d.get(CURRICULUM_LEARNING_LEGACY, {})
+
+        self.world_size = world_size
+        if world_size is not None:
+            self._configure_train_batch_size(world_size)
+
+    # ---- batch size math (reference: runtime/config.py:979) ----
+
+    def _batch_assertion(self, dp_world):
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per gpu: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * dp_world, (
+            f"Check batch related parameters. train_batch_size is not equal to micro_batch_per_gpu * "
+            f"gradient_acc_step * world_size {train_batch} != {micro_batch} * {grad_acc} * {dp_world}")
+
+    def _set_batch_related_parameters(self, dp_world):
+        train_batch = self.train_batch_size if isinstance(self.train_batch_size, int) else None
+        micro_batch = self.train_micro_batch_size_per_gpu if isinstance(self.train_micro_batch_size_per_gpu,
+                                                                        int) else None
+        grad_acc = self.gradient_accumulation_steps if isinstance(self.gradient_accumulation_steps, int) else None
+
+        if all(x is not None for x in (train_batch, micro_batch, grad_acc)):
+            pass
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= dp_world
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // dp_world
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * dp_world
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // dp_world
+        elif micro_batch is not None:
+            self.train_batch_size = micro_batch * dp_world
+            self.gradient_accumulation_steps = 1
+        else:
+            raise ValueError("Either train_batch_size or train_micro_batch_size_per_gpu needs to be provided")
+
+    def _configure_train_batch_size(self, dp_world):
+        self._set_batch_related_parameters(dp_world)
+        self._batch_assertion(dp_world)
+
+    # ---- convenience ----
+
+    @property
+    def zero_enabled(self):
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self):
+        return self.zero_config.stage
+
+    @property
+    def precision_dtype(self):
+        import jax.numpy as jnp
+        if self.fp16.enabled:
+            return jnp.float16
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        return jnp.float32
+
+    def print_config(self, name="DeepSpeedTPUConfig"):
+        logger.info(f"{name}:")
+        for k, v in sorted(self.__dict__.items()):
+            if k == "_param_dict":
+                continue
+            logger.info(f"  {k:.<40}{v}")
+
+    def to_dict(self):
+        return dict(self._param_dict)

@@ -1,0 +1,101 @@
+"""Config model base with "auto" support and deprecated-field migration.
+
+TPU-native analog of ``deepspeed/runtime/config_utils.py:16`` (DeepSpeedConfigModel).
+Sub-configs across the framework inherit from :class:`DeepSpeedConfigModel`; any
+field may be set to the literal string ``"auto"`` and later resolved by the
+engine (see ``is_auto``).
+"""
+
+from functools import reduce
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from ..utils.logging import logger
+
+AUTO_VALUE = "auto"
+
+
+def is_auto(value):
+    return isinstance(value, str) and value.lower() == AUTO_VALUE
+
+
+class DeepSpeedConfigModel(BaseModel):
+    """Pydantic base for all config blocks.
+
+    Supports:
+      - ``"auto"`` literal values (validation of such fields is skipped; the
+        engine resolves them at init time),
+      - deprecated fields via ``json_schema_extra={"deprecated": True,
+        "new_param": "other_field"}`` which transparently migrate values.
+    """
+
+    model_config = ConfigDict(
+        validate_default=True,
+        validate_assignment=True,
+        use_enum_values=True,
+        populate_by_name=True,
+        extra="allow",
+        protected_namespaces=(),
+        arbitrary_types_allowed=True,
+    )
+
+    def __init__(self, strict=False, **data):
+        if not strict:  # filter out "auto" values so field validators don't fire on them
+            data = {k: v for k, v in data.items() if not (v == "auto" and k != "optimizer")}
+        super().__init__(**data)
+        self._deprecated_fields_check()
+
+    def _process_deprecated_field(self, dep_field):
+        fields_set = self.model_fields_set
+        kwargs = type(self).model_fields[dep_field].json_schema_extra or {}
+        new_param = kwargs.get("new_param", "")
+        dep_msg = kwargs.get("deprecated_msg", "")
+        if dep_field in fields_set:
+            logger.warning(f"Config parameter {dep_field} is deprecated. {dep_msg}" +
+                           (f" Use {new_param} instead." if new_param else ""))
+            if new_param and kwargs.get("set_new_param", True):
+                assert new_param not in fields_set, \
+                    f"Cannot provide deprecated parameter '{dep_field}' and replacing parameter '{new_param}' together"
+                param_value = getattr(self, dep_field)
+                new_param_fn = kwargs.get("new_param_fn", lambda x: x)
+                try:
+                    if "." in new_param:
+                        field_parts = new_param.split(".")
+                        obj = reduce(getattr, field_parts[:-1], self)
+                        setattr(obj, field_parts[-1], new_param_fn(param_value))
+                    else:
+                        setattr(self, new_param, new_param_fn(param_value))
+                except Exception as e:
+                    logger.error(f"Tried to set value {param_value} for parameter {new_param} but failed: {e}")
+                    raise
+
+    def _deprecated_fields_check(self):
+        for field_name, field_info in type(self).model_fields.items():
+            extra = field_info.json_schema_extra
+            if isinstance(extra, dict) and extra.get("deprecated", False):
+                self._process_deprecated_field(field_name)
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_list_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def get_dict_param(param_dict, param_name, param_default_value):
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs):
+    """Reject duplicate keys when parsing the user JSON config."""
+    d = dict((k, v) for k, v in ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError("Duplicate keys {} found in config".format(keys))
+    return d

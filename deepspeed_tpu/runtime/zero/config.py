@@ -1,0 +1,105 @@
+"""ZeRO configuration.
+
+Analog of ``deepspeed/runtime/zero/config.py:83`` (DeepSpeedZeroConfig) and
+``offload_config.py``. Field names match the reference JSON schema so existing
+DeepSpeed configs parse unchanged; semantics are mapped onto JAX sharding:
+
+- stage 0: params/grads/optimizer replicated over the data axis (pure DP)
+- stage 1: optimizer state (master weights + moments) sharded over data axis
+- stage 2: + gradients reduce-scattered (transient grads carry data-sharding)
+- stage 3: + parameters stored sharded; allgathered just-in-time inside the
+  compiled step (XLA schedules the allgathers; prefetch is expressed via
+  scan-carried remat policy rather than Python-side hooks)
+"""
+
+from enum import Enum
+from typing import Optional
+
+from pydantic import Field, model_validator
+
+from ..config_utils import DeepSpeedConfigModel
+
+
+class OffloadDeviceEnum(str, Enum):
+    none = "none"
+    cpu = "cpu"
+    nvme = "nvme"
+
+
+class DeepSpeedZeroOffloadParamConfig(DeepSpeedConfigModel):
+    """Where to keep (partitioned) parameters. Analog of offload_config.py."""
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(5, ge=0)
+    buffer_size: int = Field(100_000_000, ge=0)
+    max_in_cpu: int = Field(1_000_000_000, ge=0)
+    pin_memory: bool = False
+
+
+class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
+    device: OffloadDeviceEnum = "none"
+    nvme_path: Optional[str] = None
+    buffer_count: int = Field(4, ge=0)
+    pin_memory: bool = False
+    pipeline_read: bool = False
+    pipeline_write: bool = False
+    fast_init: bool = False
+    ratio: float = Field(1.0, ge=0.0, le=1.0)
+
+
+class DeepSpeedZeroConfig(DeepSpeedConfigModel):
+    stage: int = Field(0, ge=0, le=3)
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = Field(500_000_000, ge=0)
+    use_multi_rank_bucket_allreduce: bool = True
+    allgather_partitions: bool = True
+    allgather_bucket_size: int = Field(500_000_000, ge=0)
+    overlap_comm: Optional[bool] = None
+    load_from_fp32_weights: bool = True
+    elastic_checkpoint: bool = False
+
+    # offload
+    offload_param: Optional[DeepSpeedZeroOffloadParamConfig] = None
+    offload_optimizer: Optional[DeepSpeedZeroOffloadOptimizerConfig] = None
+
+    # stage-3 specifics (kept for schema parity; under XLA prefetch/live-param
+    # management is compiled into the step — these tune the scan/remat policy)
+    sub_group_size: int = Field(1_000_000_000, ge=0)
+    max_live_parameters: int = Field(1_000_000_000, ge=0)
+    max_reuse_distance: int = Field(1_000_000_000, ge=0)
+    prefetch_bucket_size: int = Field(50_000_000, ge=0, alias="stage3_prefetch_bucket_size")
+    param_persistence_threshold: int = Field(100_000, ge=0, alias="stage3_param_persistence_threshold")
+    model_persistence_threshold: int = Field(2**62, ge=0, alias="stage3_model_persistence_threshold")
+    max_live_parameters_alias: Optional[int] = Field(None, alias="stage3_max_live_parameters")
+    max_reuse_distance_alias: Optional[int] = Field(None, alias="stage3_max_reuse_distance")
+    gather_16bit_weights_on_model_save: bool = Field(False, alias="stage3_gather_16bit_weights_on_model_save")
+
+    ignore_unused_parameters: bool = True
+    legacy_stage1: bool = False
+    round_robin_gradients: bool = False
+
+    # ZeRO++ knobs: quantized weight allgather (qwZ), hierarchical partitioning
+    # (hpZ secondary replica), quantized gradient reduction (qgZ)
+    zero_quantized_weights: bool = False
+    zero_hpz_partition_size: int = Field(1, ge=0)
+    zero_quantized_gradients: bool = False
+    zero_quantized_nontrainable_weights: bool = False
+
+    mics_shard_size: int = Field(-1, alias="mics_shard_size")
+    mics_hierarchical_params_gather: bool = False
+
+    memory_efficient_linear: bool = True
+    pipeline_loading_checkpoint: bool = False
+    override_module_apply: bool = True
+    log_trace_cache_warnings: bool = False
+
+    @model_validator(mode="after")
+    def _overlap_comm_default(self):
+        if self.overlap_comm is None:
+            object.__setattr__(self, "overlap_comm", self.stage == 3)
+        if self.max_live_parameters_alias is not None:
+            object.__setattr__(self, "max_live_parameters", self.max_live_parameters_alias)
+        if self.max_reuse_distance_alias is not None:
+            object.__setattr__(self, "max_reuse_distance", self.max_reuse_distance_alias)
+        return self

@@ -1,0 +1,3 @@
+from . import groups
+from .logging import log_dist, logger, print_rank_0
+from .timer import NoopTimer, SynchronizedWallClockTimer, ThroughputTimer

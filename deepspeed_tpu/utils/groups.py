@@ -1,0 +1,257 @@
+"""Device-mesh topology and parallel-group accessors.
+
+TPU-native analog of ``deepspeed/utils/groups.py`` + ``runtime/pipe/topology.py``.
+The reference builds torch.distributed process groups for dp/tp/pp/ep/sp; on TPU
+the single source of truth is a ``jax.sharding.Mesh`` whose named axes play the
+role of process groups:
+
+  axis       role                                  reference analog
+  ---------  ------------------------------------  -----------------------------
+  pipe       pipeline stages (p2p via ppermute)    PipelineParallelGrid
+  data       data parallel / ZeRO sharding         _get_data_parallel_group
+  expert     expert parallel (MoE all-to-all)      _get_expert_parallel_group
+  seq        sequence parallel (Ulysses/ring)      _get_sequence_parallel_group
+  tensor     tensor (model) parallel               _get_model_parallel_group
+
+Axis order is outermost→innermost = slowest→fastest links: pipe and data ride
+DCN across slices, seq/expert/tensor ride ICI. ZeRO state shards over the
+combined ("data","expert","seq") axes (the reference likewise shards ZeRO over
+the dp×sp product when Ulysses is active).
+
+All axes always exist (size-1 axes are free in XLA), so PartitionSpecs are
+uniform across configurations.
+"""
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .logging import logger
+
+MESH_AXIS_ORDER = ("pipe", "data", "expert", "seq", "tensor")
+
+# Axes whose product forms the data-parallel world used for ZeRO sharding and
+# batch distribution (seq participates in ZeRO sharding but shards the sequence
+# dim of the batch, not the batch dim).
+ZERO_AXES = ("data", "expert", "seq")
+BATCH_AXES = ("data", "expert")
+
+_MESH: Optional[Mesh] = None
+
+
+class MeshBuildError(Exception):
+    pass
+
+
+def build_mesh(mesh_config=None,
+               devices: Optional[Sequence] = None,
+               data: int = -1,
+               tensor: int = 1,
+               pipe: int = 1,
+               seq: int = 1,
+               expert: int = 1) -> Mesh:
+    """Construct the global device mesh.
+
+    ``data=-1`` (or "auto") fills with whatever devices remain after the other
+    axes are carved out.
+    """
+    if mesh_config is not None:
+        data = mesh_config.data if not isinstance(mesh_config.data, str) else -1
+        tensor, pipe, seq, expert = (mesh_config.tensor, mesh_config.pipe, mesh_config.seq, mesh_config.expert)
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    fixed = tensor * pipe * seq * expert
+    if data in (-1, None):
+        if n % fixed != 0:
+            raise MeshBuildError(f"{n} devices not divisible by tensor*pipe*seq*expert={fixed}")
+        data = n // fixed
+    total = data * fixed
+    if total != n:
+        raise MeshBuildError(f"Mesh axes product {total} != device count {n} "
+                             f"(pipe={pipe}, data={data}, expert={expert}, seq={seq}, tensor={tensor})")
+    sizes = dict(pipe=pipe, data=data, expert=expert, seq=seq, tensor=tensor)
+    shape = tuple(sizes[a] for a in MESH_AXIS_ORDER)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, MESH_AXIS_ORDER)
+
+
+def set_mesh(mesh: Mesh):
+    global _MESH
+    _MESH = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh:
+    global _MESH
+    if _MESH is None:
+        _MESH = build_mesh()
+        logger.info(f"Auto-initialized mesh: {dict(zip(_MESH.axis_names, _MESH.devices.shape))}")
+    return _MESH
+
+
+def mesh_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def reset_mesh():
+    global _MESH
+    _MESH = None
+
+
+def _axis_size(name: str) -> int:
+    mesh = get_mesh()
+    return mesh.shape[name]
+
+
+# ---- world sizes (reference: utils/groups.py accessors) ----
+
+def get_world_size() -> int:
+    return math.prod(get_mesh().devices.shape)
+
+def get_data_parallel_world_size() -> int:
+    return math.prod(_axis_size(a) for a in BATCH_AXES)
+
+def get_zero_world_size() -> int:
+    return math.prod(_axis_size(a) for a in ZERO_AXES)
+
+def get_model_parallel_world_size() -> int:
+    return _axis_size("tensor")
+
+get_tensor_model_parallel_world_size = get_model_parallel_world_size
+
+def get_pipe_parallel_world_size() -> int:
+    return _axis_size("pipe")
+
+def get_sequence_parallel_world_size() -> int:
+    return _axis_size("seq")
+
+def get_expert_parallel_world_size(group_name: str = "default") -> int:
+    return _axis_size("expert")
+
+def get_expert_data_parallel_world_size(group_name: str = "default") -> int:
+    return get_data_parallel_world_size() // get_expert_parallel_world_size()
+
+def sequence_parallel_is_initialized() -> bool:
+    return mesh_is_initialized() and get_sequence_parallel_world_size() > 1
+
+def get_data_parallel_group():
+    """Returns the mesh axis names forming the data-parallel 'group'."""
+    return BATCH_AXES
+
+def get_model_parallel_group():
+    return ("tensor",)
+
+def get_sequence_parallel_group():
+    return ("seq",)
+
+def get_expert_parallel_group(group_name: str = "default"):
+    return ("expert",)
+
+def get_pipe_parallel_group():
+    return ("pipe",)
+
+
+# ---- sharding helpers ----
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(get_mesh(), P(*spec))
+
+def replicated_sharding() -> NamedSharding:
+    return NamedSharding(get_mesh(), P())
+
+def batch_sharding() -> NamedSharding:
+    """Shard the leading (batch) dim over the data-like axes."""
+    return NamedSharding(get_mesh(), P(BATCH_AXES))
+
+
+class ProcessTopology:
+    """Cartesian rank↔coordinate mapping over named axes.
+
+    Analog of ``runtime/pipe/topology.py:12``. On TPU the mesh already encodes
+    this; kept for API parity and for the launcher/checkpoint layers that
+    reason about ranks without a live mesh.
+    """
+
+    def __init__(self, axes, dims):
+        self.axes = list(axes)
+        self.dims = list(dims)
+        import itertools
+        from collections import namedtuple
+        self.ProcessCoord = namedtuple("ProcessCoord", axes)
+        self.mapping = {}
+        ranges = [range(d) for d in dims]
+        for global_rank, coord in enumerate(itertools.product(*ranges)):
+            key = dict(zip(axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs):
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self):
+        return self.axes
+
+    def get_rank_repr(self, rank, omit_axes=("data",), inner_sep="_", outer_sep="-"):
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.axes if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis):
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not found in topology")
+
+    def get_axis_comm_lists(self, axis):
+        """Lists of ranks that vary only along ``axis`` (i.e. its comm groups)."""
+        if axis not in self.axes:
+            return []
+        import itertools
+        other_axes = [a for a in self.axes if a != axis]
+        lists = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for combo in itertools.product(*ranges):
+            fixed = dict(zip(other_axes, combo))
+            ranks = [self.get_rank(**{axis: i}, **fixed) for i in range(self.get_dim(axis))]
+            lists.append(ranks)
+        return lists
+
+    def filter_match(self, **filter_kwargs):
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return [rank for coord, rank in self.mapping.items() if _match(coord)]
+
+    def get_axis_list(self, axis, idx):
+        return self.filter_match(**{axis: idx})
+
+    def world_size(self):
+        return len(self.mapping)
+
+    def __str__(self):
+        return str(self.mapping)
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """Analog of ``runtime/pipe/topology.py:244``."""
+
+    def __init__(self, num_pp, num_mp, num_dp):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])

@@ -1,0 +1,199 @@
+"""Wall-clock and throughput timers.
+
+TPU-native analog of ``deepspeed/utils/timer.py`` (SynchronizedWallClockTimer,
+ThroughputTimer). Synchronization uses ``jax.block_until_ready`` on a tiny
+device computation instead of CUDA events: XLA executions are asynchronously
+dispatched exactly like CUDA streams, so a fence is required for honest timing.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_fence():
+    """Block until all outstanding device work is complete."""
+    try:
+        jax.block_until_ready(jnp.zeros((), dtype=jnp.float32) + 0)
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timer group with device synchronization before reads."""
+
+    class Timer:
+        def __init__(self, name):
+            self.name_ = name
+            self.started_ = False
+            self.start_time = 0.0
+            self.elapsed_ = 0.0
+            self.count = 0
+
+        def start(self, sync=True):
+            if self.started_:
+                return
+            if sync:
+                _device_fence()
+            self.start_time = time.perf_counter()
+            self.started_ = True
+
+        def stop(self, sync=True, record=True):
+            if not self.started_:
+                return
+            if sync:
+                _device_fence()
+            if record:
+                self.elapsed_ += time.perf_counter() - self.start_time
+                self.count += 1
+            self.started_ = False
+
+        def reset(self):
+            self.started_ = False
+            self.elapsed_ = 0.0
+            self.count = 0
+
+        def elapsed(self, reset=True):
+            started = self.started_
+            if started:
+                self.stop()
+            elapsed = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                self.start()
+            return elapsed
+
+        def mean(self):
+            return self.elapsed_ / max(self.count, 1)
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def get_timers(self):
+        return self.timers
+
+    def log(self, names, normalizer=1.0, reset=True, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0, reset=True):
+        assert normalizer > 0.0
+        means = {}
+        for name in names:
+            if name in self.timers:
+                means[name] = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+        return means
+
+
+class ThroughputTimer:
+    """Tracks samples/sec and (optionally) TFLOPS across train batches.
+
+    Analog of ``deepspeed/utils/timer.py:199``.
+    """
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False, logging_fn=None):
+        self.start_time = 0
+        self.end_time = 0
+        self.started = False
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or log_dist
+        self.initialized = False
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self):
+        self.initialized = True
+
+    def start(self):
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_fence()
+            self.start_time = time.perf_counter()
+
+    def stop(self, global_step=False, report_speed=True):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_fence()
+            self.end_time = time.perf_counter()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step and report_speed and self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6g}, "
+                    f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.6g}")
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return samples_per_step / max(avg_time_per_step, 1e-12)
+        return float("-inf")
+
+
+class NoopTimer:
+    class Timer:
+        def start(self, **kw):
+            ...
+
+        def stop(self, **kw):
+            ...
+
+        def reset(self):
+            ...
+
+        def elapsed(self, **kw):
+            return 0.0
+
+    def __call__(self, name):
+        return self.Timer()
+
+    def get_timers(self):
+        return {}
+
+    def log(self, *args, **kwargs):
+        ...
+
+    def get_mean(self, *args, **kwargs):
+        return {}

@@ -1,0 +1,52 @@
+"""Test harness configuration.
+
+Analog of the reference's ``tests/unit/common.py`` DistributedTest pattern:
+multi-chip logic is tested on a virtual 8-device CPU mesh via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4's TPU-build
+implication) — ZeRO/pipeline/MoE/SP collectives execute for real across 8
+simulated devices in one process.
+"""
+
+import os
+
+# Must run before any backend is initialized. The axon sitecustomize imports
+# jax at interpreter start with JAX_PLATFORMS=axon, so the env var is already
+# latched — jax.config.update is the reliable override.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    """Each test starts with a fresh (unset) global mesh."""
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    yield
+    groups.reset_mesh()
+
+
+@pytest.fixture
+def mesh_8dp():
+    from deepspeed_tpu.utils import groups
+    return groups.set_mesh(groups.build_mesh(data=8))
+
+
+@pytest.fixture
+def mesh_2x4():
+    """2-way data x 4-way tensor."""
+    from deepspeed_tpu.utils import groups
+    return groups.set_mesh(groups.build_mesh(data=2, tensor=4))
+
+
+@pytest.fixture
+def rng():
+    return jax.random.PRNGKey(0)

@@ -1,0 +1,103 @@
+"""Config system tests. Models reference tests/unit/runtime/test_ds_config_dict.py."""
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def test_batch_resolution_all_given():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2},
+        world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_resolution_infer_gas():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2}, world_size=8)
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_resolution_infer_micro():
+    cfg = DeepSpeedConfig({"train_batch_size": 32, "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_resolution_infer_train():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2}, world_size=8)
+    assert cfg.train_batch_size == 32
+
+
+def test_batch_mismatch_raises():
+    with pytest.raises(AssertionError):
+        DeepSpeedConfig(
+            {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2, "gradient_accumulation_steps": 2},
+            world_size=8)
+
+
+def test_no_batch_info_raises():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({}, world_size=8)
+
+
+def test_zero_config_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8})
+    assert cfg.zero_config.stage == 0
+    assert not cfg.zero_enabled
+
+
+def test_zero_stage3_aliases():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "zero_optimization": {
+            "stage": 3,
+            "stage3_prefetch_bucket_size": 1000,
+            "stage3_max_live_parameters": 123,
+            "offload_optimizer": {"device": "cpu"},
+        }
+    })
+    assert cfg.zero_config.stage == 3
+    assert cfg.zero_config.prefetch_bucket_size == 1000
+    assert cfg.zero_config.max_live_parameters == 123
+    assert cfg.zero_config.offload_optimizer.device == "cpu"
+    assert cfg.zero_config.overlap_comm  # defaults True at stage 3
+
+
+def test_fp16_bf16_exclusive():
+    with pytest.raises(ValueError):
+        DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}, "bf16": {"enabled": True}})
+
+
+def test_precision_dtype():
+    import jax.numpy as jnp
+    assert DeepSpeedConfig({"train_batch_size": 8, "bf16": {"enabled": True}}).precision_dtype == jnp.bfloat16
+    assert DeepSpeedConfig({"train_batch_size": 8, "fp16": {"enabled": True}}).precision_dtype == jnp.float16
+    assert DeepSpeedConfig({"train_batch_size": 8}).precision_dtype == jnp.float32
+
+
+def test_duplicate_keys_rejected(tmp_path):
+    p = tmp_path / "dup.json"
+    p.write_text('{"train_batch_size": 8, "train_batch_size": 16}')
+    with pytest.raises(ValueError):
+        DeepSpeedConfig(str(p))
+
+
+def test_json_string_config():
+    cfg = DeepSpeedConfig('{"train_batch_size": 16}', world_size=8)
+    assert cfg.train_batch_size == 16
+
+
+def test_optimizer_scheduler_blocks():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 3e-4, "weight_decay": 0.01}},
+        "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 100}},
+    })
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.optimizer.params["lr"] == 3e-4
+    assert cfg.scheduler.type == "WarmupLR"
+
+
+def test_mesh_block():
+    cfg = DeepSpeedConfig({"train_batch_size": 8, "mesh": {"tensor": 4, "pipe": 2}})
+    assert cfg.mesh.tensor == 4
+    assert cfg.mesh.pipe == 2
