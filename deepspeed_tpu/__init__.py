@@ -51,8 +51,14 @@ def initialize(args=None,
         config = getattr(args, "deepspeed_config", None)
     assert model is not None, "deepspeed_tpu.initialize requires a model"
 
+    ds_config = None if config is None else DeepSpeedConfig(config)
     init_distributed(distributed_port=distributed_port, verbose=False,
-                     mesh_config=None if config is None else DeepSpeedConfig(config).mesh)
+                     mesh_config=None if ds_config is None else ds_config.mesh)
+    if ds_config is not None and ds_config.world_size is None:
+        from .utils import groups
+        ds_config._configure_train_batch_size(groups.get_data_parallel_world_size())
+        ds_config.world_size = groups.get_data_parallel_world_size()
+    config = ds_config if ds_config is not None else config
 
     engine = DeepSpeedEngine(args=args,
                              model=model,

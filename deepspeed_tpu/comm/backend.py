@@ -42,7 +42,7 @@ class ReduceOp:
 
 
 def _lax_reduce(op, x, axis_name):
-    if op in (ReduceOp.SUM, ReduceOp.BOR):
+    if op == ReduceOp.SUM:
         return jax.lax.psum(x, axis_name)
     if op == ReduceOp.AVG:
         return jax.lax.pmean(x, axis_name)
@@ -50,8 +50,15 @@ def _lax_reduce(op, x, axis_name):
         return jax.lax.pmax(x, axis_name)
     if op == ReduceOp.MIN:
         return jax.lax.pmin(x, axis_name)
-    if op == ReduceOp.PROD:
-        return jnp.exp(jax.lax.psum(jnp.log(x), axis_name))
+    if op in (ReduceOp.PROD, ReduceOp.BAND, ReduceOp.BOR, ReduceOp.BXOR):
+        # No native XLA collective: gather the n shards (n static) and fold.
+        import functools as ft
+        gathered = jax.lax.all_gather(x, axis_name, axis=0, tiled=False)
+        if op == ReduceOp.PROD:
+            return jnp.prod(gathered, axis=0)
+        fold = {ReduceOp.BAND: jnp.bitwise_and, ReduceOp.BOR: jnp.bitwise_or,
+                ReduceOp.BXOR: jnp.bitwise_xor}[op]
+        return ft.reduce(fold, [gathered[i] for i in range(gathered.shape[0])])
     raise ValueError(f"Unsupported reduce op: {op}")
 
 
@@ -76,11 +83,14 @@ class XlaBackend:
 
     def __init__(self):
         self._initialized = False
+        self._collective_cache = {}
 
     def init_process_group(self, coordinator_address=None, num_processes=None, process_id=None):
         if self._initialized:
             return
         if num_processes is not None and num_processes > 1:
+            # Must run before ANY jax call that touches the XLA backend
+            # (callers must not query jax.devices()/process_count() first).
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
@@ -103,9 +113,12 @@ class XlaBackend:
 
     # -- eager collectives (operate on mesh-sharded arrays) --
 
-    @functools.lru_cache(maxsize=256)
     def _make_collective(self, kind, axis_names, op, ndim, scatter_dim=0, gather_dim=0):
         mesh = groups.get_mesh()
+        key = (mesh, kind, axis_names, op, ndim, scatter_dim, gather_dim)
+        cached = self._collective_cache.get(key)
+        if cached is not None:
+            return cached
         axis = axis_names if len(axis_names) > 1 else axis_names[0]
         full = P(*([None] * ndim))
 
@@ -143,7 +156,11 @@ class XlaBackend:
             raise ValueError(kind)
 
         smapped = shard_map(fn, mesh, (in_spec,), out_spec, check_rep=False)
-        return jax.jit(smapped)
+        jitted = jax.jit(smapped)
+        if len(self._collective_cache) > 512:
+            self._collective_cache.clear()
+        self._collective_cache[key] = jitted
+        return jitted
 
     def all_reduce(self, tensor, op=ReduceOp.SUM, group=None):
         axes = _normalize_group(group)
@@ -175,6 +192,7 @@ class XlaBackend:
 
     def destroy_process_group(self):
         self._initialized = False
+        self._collective_cache.clear()
 
 
 # In-trace collective functions — usable inside shard_map'd code. These are the

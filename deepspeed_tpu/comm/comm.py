@@ -138,10 +138,13 @@ def init_distributed(dist_backend="xla",
         return cdb
     cdb = XlaBackend()
 
+    # Decide multi-process bring-up from env/args ONLY: touching
+    # jax.process_count()/jax.devices() here would initialize the XLA backend
+    # and make the subsequent jax.distributed.initialize() fail.
     coordinator = os.environ.get("MASTER_ADDR")
     n_proc = int(os.environ.get("WORLD_SIZE", world_size if world_size > 0 else 1))
     proc_id = int(os.environ.get("RANK", rank if rank >= 0 else 0))
-    if n_proc > 1 and jax.process_count() == 1:
+    if n_proc > 1:
         addr = f"{coordinator}:{distributed_port}" if coordinator else None
         cdb.init_process_group(coordinator_address=addr, num_processes=n_proc, process_id=proc_id)
     else:

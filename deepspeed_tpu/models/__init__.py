@@ -1,0 +1,93 @@
+"""Native model zoo + adapters for user-supplied models."""
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from .config import PRESETS, TransformerConfig, get_config  # noqa: F401
+from .transformer import CausalLM, build_model  # noqa: F401
+
+
+class FunctionalModel:
+    """Adapter for a bare ``(params, loss_fn)`` pair.
+
+    ``loss_fn(params, batch) -> scalar`` drives training; ``apply_fn`` is
+    optional when only training is needed.
+    """
+
+    def __init__(self, params, loss_fn: Callable, apply_fn: Optional[Callable] = None,
+                 logical_axes=None):
+        self._params = params
+        self._loss_fn = loss_fn
+        self._apply_fn = apply_fn
+        self._logical = logical_axes
+
+    def init(self, rng):
+        return self._params
+
+    def abstract_params(self):
+        return jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self._params)
+
+    def logical_axes(self):
+        if self._logical is not None:
+            return self._logical
+
+        def default_axes(x):
+            if x.ndim == 0:
+                return ()
+            return ("embed",) + ("unmodeled",) * (x.ndim - 1)
+        return jax.tree.map(default_axes, self._params)
+
+    def apply(self, params, *args, **kwargs):
+        assert self._apply_fn is not None, "FunctionalModel built without apply_fn"
+        return self._apply_fn(params, *args, **kwargs)
+
+    def loss(self, params, batch):
+        return self._loss_fn(params, batch)
+
+
+class FlaxModel:
+    """Adapter for a flax ``nn.Module`` with an LM-style loss."""
+
+    def __init__(self, module, example_batch, loss_fn=None):
+        self.module = module
+        self._example = example_batch
+        self._loss_fn = loss_fn
+
+    def init(self, rng):
+        return self.module.init(rng, self._example["input_ids"])["params"]
+
+    def abstract_params(self):
+        return jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+
+    def logical_axes(self):
+        def default_axes(x):
+            if x.ndim == 0:
+                return ()
+            return ("embed",) + ("unmodeled",) * (x.ndim - 1)
+        return jax.tree.map(default_axes, self.abstract_params())
+
+    def apply(self, params, *args, **kwargs):
+        return self.module.apply({"params": params}, *args, **kwargs)
+
+    def loss(self, params, batch):
+        if self._loss_fn is not None:
+            return self._loss_fn(self.module, params, batch)
+        import jax.numpy as jnp
+        logits = self.apply(params, batch["input_ids"])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, batch["labels"][..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+
+def as_model(model: Any):
+    """Normalize user input (CausalLM, adapter, preset name, config)."""
+    if isinstance(model, str):
+        return build_model(model)
+    if isinstance(model, TransformerConfig):
+        return build_model(model)
+    if hasattr(model, "init") and hasattr(model, "loss"):
+        return model
+    raise TypeError(f"Unsupported model type {type(model)}; expected CausalLM, FunctionalModel, "
+                    "FlaxModel, preset name, or TransformerConfig "
+                    "(wrap flax modules with deepspeed_tpu.models.FlaxModel)")

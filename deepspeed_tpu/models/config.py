@@ -1,0 +1,123 @@
+"""Model configurations and preset registry.
+
+The reference frameworks ships no model zoo for training (users bring
+torch modules) but its benchmark configs name concrete architectures
+(BASELINE.md acceptance configs: GPT-2-small, BERT-large, Llama-2-7B,
+Mixtral-8x7B, Llama-2-70B). deepspeed_tpu ships a native functional
+transformer covering those families; HF models are adapted via
+``module_inject`` at inference time.
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: Optional[int] = None  # None → MHA
+    head_dim: Optional[int] = None      # None → hidden_size // num_heads
+    intermediate_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
+    max_seq_len: int = 4096
+    activation: str = "swiglu"          # "swiglu" | "gelu"
+    norm: str = "rmsnorm"               # "rmsnorm" | "layernorm"
+    position: str = "rope"              # "rope" | "learned"
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    use_bias: bool = False
+    causal: bool = True
+    # MoE (Mixtral-style; 0 experts → dense)
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_loss_coef: float = 0.01
+    # numerics
+    dtype: str = "bfloat16"             # activation dtype
+    param_dtype: str = "float32"        # stored parameter dtype
+    # remat policy for scan-over-layers ("none"|"full"|"dots")
+    remat: str = "none"
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
+
+    @property
+    def dims_per_head(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self) -> int:
+        if self.intermediate_size is not None:
+            return self.intermediate_size
+        if self.activation == "swiglu":
+            return ((int(self.hidden_size * 8 / 3) + 255) // 256) * 256
+        return 4 * self.hidden_size
+
+    @property
+    def act_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    @property
+    def p_dtype(self):
+        return jnp.dtype(self.param_dtype)
+
+    @property
+    def is_moe(self) -> bool:
+        return self.num_experts > 0
+
+    def replace(self, **kw):
+        return dataclasses.replace(self, **kw)
+
+
+# ---- preset registry (sizes from the public model cards) ----
+
+PRESETS = {
+    # GPT-2 family (learned positions, gelu, layernorm, tied embeddings, biases)
+    "gpt2-small": TransformerConfig(vocab_size=50257, hidden_size=768, num_layers=12, num_heads=12,
+                                    max_seq_len=1024, activation="gelu", norm="layernorm", position="learned",
+                                    tie_embeddings=True, use_bias=True),
+    "gpt2-medium": TransformerConfig(vocab_size=50257, hidden_size=1024, num_layers=24, num_heads=16,
+                                     max_seq_len=1024, activation="gelu", norm="layernorm", position="learned",
+                                     tie_embeddings=True, use_bias=True),
+    "gpt2-xl": TransformerConfig(vocab_size=50257, hidden_size=1600, num_layers=48, num_heads=25,
+                                 max_seq_len=1024, activation="gelu", norm="layernorm", position="learned",
+                                 tie_embeddings=True, use_bias=True),
+    # Llama-2 family
+    "llama2-7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+                                   intermediate_size=11008, max_seq_len=4096),
+    "llama2-13b": TransformerConfig(vocab_size=32000, hidden_size=5120, num_layers=40, num_heads=40,
+                                    intermediate_size=13824, max_seq_len=4096),
+    "llama2-70b": TransformerConfig(vocab_size=32000, hidden_size=8192, num_layers=80, num_heads=64,
+                                    num_kv_heads=8, intermediate_size=28672, max_seq_len=4096),
+    "llama3-8b": TransformerConfig(vocab_size=128256, hidden_size=4096, num_layers=32, num_heads=32,
+                                   num_kv_heads=8, intermediate_size=14336, max_seq_len=8192,
+                                   rope_theta=500000.0),
+    # Mixtral MoE
+    "mixtral-8x7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+                                      num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
+                                      rope_theta=1e6, num_experts=8, num_experts_per_tok=2),
+    # tiny variants for tests / CI
+    "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                              intermediate_size=128, max_seq_len=128, param_dtype="float32",
+                              dtype="float32"),
+    "tiny-gpt2": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                                   intermediate_size=256, max_seq_len=128, activation="gelu",
+                                   norm="layernorm", position="learned", tie_embeddings=True,
+                                   use_bias=True, dtype="float32"),
+    "tiny-moe": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                                  intermediate_size=128, max_seq_len=128, num_experts=4,
+                                  num_experts_per_tok=2, dtype="float32"),
+}
+
+
+def get_config(name: str, **overrides) -> TransformerConfig:
+    if name not in PRESETS:
+        raise KeyError(f"Unknown model preset {name!r}; available: {sorted(PRESETS)}")
+    cfg = PRESETS[name]
+    return cfg.replace(**overrides) if overrides else cfg

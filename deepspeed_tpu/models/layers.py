@@ -1,0 +1,247 @@
+"""Transformer layer primitives: pure functions over explicit param pytrees.
+
+Every parameter leaf has a parallel *logical-axes* annotation (see
+``parallel/sharding.py``) so ZeRO/TP/EP sharding is declarative. Initializers
+follow the conventions the reference's target models use (normal(0.02) for
+embeddings, scaled-variance for projections).
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import multihead_attention, decode_attention
+from .config import TransformerConfig
+
+# ---- init helpers -------------------------------------------------------
+
+def _normal(rng, shape, dtype, stddev):
+    return (jax.random.normal(rng, shape, jnp.float32) * stddev).astype(dtype)
+
+
+def _zeros(shape, dtype):
+    return jnp.zeros(shape, dtype)
+
+
+def _ones(shape, dtype):
+    return jnp.ones(shape, dtype)
+
+
+# ---- norms --------------------------------------------------------------
+
+def init_norm(cfg: TransformerConfig):
+    params = {"scale": _ones((cfg.hidden_size,), cfg.p_dtype)}
+    axes = {"scale": ("embed",)}
+    if cfg.norm == "layernorm":
+        params["bias"] = _zeros((cfg.hidden_size,), cfg.p_dtype)
+        axes["bias"] = ("embed",)
+    return params, axes
+
+
+def apply_norm(params, x, cfg: TransformerConfig):
+    x32 = x.astype(jnp.float32)
+    if cfg.norm == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + cfg.norm_eps)
+        return (y * params["scale"].astype(jnp.float32)).astype(x.dtype)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+    y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+    return y.astype(x.dtype)
+
+
+# ---- rotary embeddings --------------------------------------------------
+
+def rope_frequencies(cfg: TransformerConfig):
+    d = cfg.dims_per_head
+    inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    return inv_freq  # (d/2,)
+
+
+def apply_rope(x, positions, inv_freq):
+    """x: (B, S, H, D); positions: (B, S) int32."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B, S, D/2)
+    sin = jnp.sin(angles)[:, :, None, :]
+    cos = jnp.cos(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ---- attention ----------------------------------------------------------
+
+def init_attention(rng, cfg: TransformerConfig):
+    e, h, kvh, d = cfg.hidden_size, cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+    r = jax.random.split(rng, 4)
+    std = 0.02
+    params = {
+        "wq": _normal(r[0], (e, h, d), cfg.p_dtype, std),
+        "wk": _normal(r[1], (e, kvh, d), cfg.p_dtype, std),
+        "wv": _normal(r[2], (e, kvh, d), cfg.p_dtype, std),
+        "wo": _normal(r[3], (h, d, e), cfg.p_dtype, std / math.sqrt(2 * cfg.num_layers)),
+    }
+    axes = {
+        "wq": ("embed", "heads", "head_dim"),
+        "wk": ("embed", "kv_heads", "head_dim"),
+        "wv": ("embed", "kv_heads", "head_dim"),
+        "wo": ("heads", "head_dim", "embed"),
+    }
+    if cfg.use_bias:
+        params.update(bq=_zeros((h, d), cfg.p_dtype), bk=_zeros((kvh, d), cfg.p_dtype),
+                      bv=_zeros((kvh, d), cfg.p_dtype), bo=_zeros((e,), cfg.p_dtype))
+        axes.update(bq=("heads", "head_dim"), bk=("kv_heads", "head_dim"),
+                    bv=("kv_heads", "head_dim"), bo=("embed",))
+    return params, axes
+
+
+def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_freq=None,
+                    segment_ids=None, kv_cache=None, cache_len=None):
+    """x: (B, S, E). Returns (out, new_kv_cache).
+
+    Training: kv_cache None. Decode: kv_cache = (k, v) with shape
+    (B, S_max, KVH, D); new tokens are written at ``cache_len`` offsets.
+    """
+    dt = cfg.act_dtype
+    q = jnp.einsum("bse,ehd->bshd", x, params["wq"].astype(dt))
+    k = jnp.einsum("bse,ehd->bshd", x, params["wk"].astype(dt))
+    v = jnp.einsum("bse,ehd->bshd", x, params["wv"].astype(dt))
+    if cfg.use_bias:
+        q = q + params["bq"].astype(dt)
+        k = k + params["bk"].astype(dt)
+        v = v + params["bv"].astype(dt)
+    if cfg.position == "rope":
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
+        q = apply_rope(q, positions, inv_freq)
+        k = apply_rope(k, positions, inv_freq)
+
+    new_cache = None
+    if kv_cache is not None:
+        ck, cv = kv_cache
+        # write the S new entries at cache_len offset (decode S is typically 1)
+        b, s = x.shape[:2]
+        idx = cache_len[:, None] + jnp.arange(s)[None, :]  # (B, S)
+        ck = _scatter_cache(ck, k, idx)
+        cv = _scatter_cache(cv, v, idx)
+        new_cache = (ck, cv)
+        out = decode_attention(q, ck, cv, cache_len + s)
+    else:
+        out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids)
+
+    y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
+    if cfg.use_bias:
+        y = y + params["bo"].astype(dt)
+    return y, new_cache
+
+
+def _scatter_cache(cache, new, idx):
+    """cache: (B, S_max, H, D); new: (B, S, H, D); idx: (B, S) positions."""
+    b = cache.shape[0]
+    bidx = jnp.broadcast_to(jnp.arange(b)[:, None], idx.shape)
+    return cache.at[bidx, idx].set(new.astype(cache.dtype))
+
+
+# ---- MLP ----------------------------------------------------------------
+
+def init_mlp(rng, cfg: TransformerConfig):
+    e, f = cfg.hidden_size, cfg.ffn_size
+    r = jax.random.split(rng, 3)
+    std = 0.02
+    if cfg.activation == "swiglu":
+        params = {
+            "wi_gate": _normal(r[0], (e, f), cfg.p_dtype, std),
+            "wi_up": _normal(r[1], (e, f), cfg.p_dtype, std),
+            "wo": _normal(r[2], (f, e), cfg.p_dtype, std / math.sqrt(2 * cfg.num_layers)),
+        }
+        axes = {"wi_gate": ("embed", "mlp"), "wi_up": ("embed", "mlp"), "wo": ("mlp", "embed")}
+    else:
+        params = {
+            "wi": _normal(r[0], (e, f), cfg.p_dtype, std),
+            "wo": _normal(r[2], (f, e), cfg.p_dtype, std / math.sqrt(2 * cfg.num_layers)),
+        }
+        axes = {"wi": ("embed", "mlp"), "wo": ("mlp", "embed")}
+    if cfg.use_bias:
+        params.update(bi=_zeros((f,), cfg.p_dtype), bo=_zeros((e,), cfg.p_dtype))
+        axes.update(bi=("mlp",), bo=("embed",))
+    return params, axes
+
+
+def apply_mlp(params, x, cfg: TransformerConfig):
+    dt = cfg.act_dtype
+    if cfg.activation == "swiglu":
+        g = jnp.einsum("bse,ef->bsf", x, params["wi_gate"].astype(dt))
+        u = jnp.einsum("bse,ef->bsf", x, params["wi_up"].astype(dt))
+        h = jax.nn.silu(g) * u
+    else:
+        h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
+        if cfg.use_bias:
+            h = h + params["bi"].astype(dt)
+        h = jax.nn.gelu(h, approximate=True)
+    y = jnp.einsum("bsf,fe->bse", h, params["wo"].astype(dt))
+    if cfg.use_bias:
+        y = y + params["bo"].astype(dt)
+    return y
+
+
+# ---- MoE MLP ------------------------------------------------------------
+
+def init_moe_mlp(rng, cfg: TransformerConfig):
+    """Mixtral-style top-k routed experts with swiglu experts."""
+    e, f, x = cfg.hidden_size, cfg.ffn_size, cfg.num_experts
+    r = jax.random.split(rng, 4)
+    std = 0.02
+    params = {
+        "router": _normal(r[0], (e, x), cfg.p_dtype, std),
+        "wi_gate": _normal(r[1], (x, e, f), cfg.p_dtype, std),
+        "wi_up": _normal(r[2], (x, e, f), cfg.p_dtype, std),
+        "wo": _normal(r[3], (x, f, e), cfg.p_dtype, std / math.sqrt(2 * cfg.num_layers)),
+    }
+    axes = {
+        "router": ("embed", "unmodeled"),
+        "wi_gate": ("expert", "embed", "mlp"),
+        "wi_up": ("expert", "embed", "mlp"),
+        "wo": ("expert", "mlp", "embed"),
+    }
+    return params, axes
+
+
+def apply_moe_mlp(params, x, cfg: TransformerConfig):
+    """Dispatch/combine via one-hot einsum (GShard-style, reference
+    ``deepspeed/moe/sharded_moe.py:96 MOELayer``). Capacity-bounded, dropless
+    within capacity; aux load-balancing loss returned alongside.
+    """
+    from ..moe.sharded_moe import topk_gating_einsum
+    dt = cfg.act_dtype
+    b, s, e = x.shape
+    tokens = x.reshape(b * s, e)
+    logits = jnp.einsum("te,ex->tx", tokens.astype(jnp.float32),
+                        params["router"].astype(jnp.float32))
+    combine, dispatch, aux_loss = topk_gating_einsum(
+        logits, k=cfg.num_experts_per_tok, capacity_factor=cfg.moe_capacity_factor)
+    # dispatch: (T, X, C) bool → expert inputs (X, C, E)
+    expert_in = jnp.einsum("txc,te->xce", dispatch.astype(dt), tokens)
+    g = jnp.einsum("xce,xef->xcf", expert_in, params["wi_gate"].astype(dt))
+    u = jnp.einsum("xce,xef->xcf", expert_in, params["wi_up"].astype(dt))
+    h = jax.nn.silu(g) * u
+    expert_out = jnp.einsum("xcf,xfe->xce", h, params["wo"].astype(dt))
+    out = jnp.einsum("txc,xce->te", combine.astype(dt), expert_out)
+    return out.reshape(b, s, e), aux_loss
+
+
+# ---- embeddings ---------------------------------------------------------
+
+def init_embeddings(rng, cfg: TransformerConfig):
+    r = jax.random.split(rng, 3)
+    params = {"tok": _normal(r[0], (cfg.vocab_size, cfg.hidden_size), cfg.p_dtype, 0.02)}
+    axes = {"tok": ("vocab", "embed")}
+    if cfg.position == "learned":
+        params["pos"] = _normal(r[1], (cfg.max_seq_len, cfg.hidden_size), cfg.p_dtype, 0.02)
+        axes["pos"] = ("unmodeled", "embed")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = _normal(r[2], (cfg.hidden_size, cfg.vocab_size), cfg.p_dtype,
+                                    cfg.hidden_size ** -0.5)
+        axes["lm_head"] = ("embed", "vocab")
+    return params, axes

@@ -1,0 +1,228 @@
+"""Causal transformer LM: the framework's native model family.
+
+Functional design: ``CausalLM(cfg)`` exposes ``init(rng) -> params``,
+``apply(params, input_ids, ...) -> logits``, ``loss(params, batch) -> scalar``
+and ``logical_axes()`` — a parallel pytree of logical-axis tuples consumed by
+``parallel/sharding.py`` to derive ZeRO/TP/EP shardings.
+
+Layers are stacked along a leading "layers" dim and executed with
+``lax.scan`` (one compile of one layer regardless of depth — the XLA analog
+of the reference's per-layer module loop). Activation checkpointing is
+``jax.checkpoint`` on the scan body (reference
+``runtime/activation_checkpointing/checkpointing.py:486``).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from . import layers as L
+from .config import TransformerConfig, get_config
+
+
+def _is_axes_leaf(x):
+    return isinstance(x, tuple) and all(isinstance(i, (str, type(None))) for i in x)
+
+
+def _axes_of(init_fn):
+    """Extract the logical-axes tree of an ``init_fn(rng) -> (params, axes)``
+    without allocating parameter memory (shapes traced via eval_shape; the
+    axes dict escapes through a side channel)."""
+    box = []
+
+    def wrapped(rng):
+        out = init_fn(rng)
+        params, axes = out if isinstance(out, tuple) else (out, {})
+        box.append(axes)
+        return params
+
+    jax.eval_shape(wrapped, jax.random.PRNGKey(0))
+    return box[0]
+
+
+def _remat_policy(name: str):
+    if name == "full":
+        return None  # jax.checkpoint default: save nothing
+    if name == "dots":
+        return jax.checkpoint_policies.checkpoint_dots
+    if name == "dots_no_batch":
+        return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    return None
+
+
+class CausalLM:
+    """Decoder-only LM covering GPT-2 / Llama / Mixtral families."""
+
+    def __init__(self, cfg: TransformerConfig):
+        self.cfg = cfg
+        self._inv_freq = L.rope_frequencies(cfg) if cfg.position == "rope" else None
+
+    # -- init --
+
+    def _init_layer(self, rng):
+        cfg = self.cfg
+        r_attn, r_mlp = jax.random.split(rng)
+        attn, attn_axes = L.init_attention(r_attn, cfg)
+        if cfg.is_moe:
+            mlp, mlp_axes = L.init_moe_mlp(r_mlp, cfg)
+        else:
+            mlp, mlp_axes = L.init_mlp(r_mlp, cfg)
+        norm1, norm1_axes = L.init_norm(cfg)
+        norm2, norm2_axes = L.init_norm(cfg)
+        params = {"attn": attn, "mlp": mlp, "norm1": norm1, "norm2": norm2}
+        axes = {"attn": attn_axes, "mlp": mlp_axes, "norm1": norm1_axes, "norm2": norm2_axes}
+        return params, axes
+
+    def init(self, rng):
+        cfg = self.cfg
+        r_emb, r_layers = jax.random.split(rng)
+        emb, _ = L.init_embeddings(r_emb, cfg)
+        layer_rngs = jax.random.split(r_layers, cfg.num_layers)
+        per_layer = [self._init_layer(r)[0] for r in layer_rngs]
+        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+        fnorm, _ = L.init_norm(cfg)
+        return {"embed": emb, "layers": stacked, "final_norm": fnorm}
+
+    def abstract_params(self):
+        """Shape/dtype tree without allocating (for sharded init)."""
+        return jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+
+    def logical_axes(self):
+        """Pytree of logical-axis tuples mirroring ``init``'s output; stacked
+        layer params get a leading "layers" axis."""
+        cfg = self.cfg
+        emb_axes = _axes_of(lambda r: L.init_embeddings(r, cfg))
+        layer_axes = _axes_of(self._init_layer)
+        stacked_axes = jax.tree.map(lambda a: ("layers",) + a, layer_axes, is_leaf=_is_axes_leaf)
+        norm_axes = _axes_of(lambda r: L.init_norm(cfg))
+        return {"embed": emb_axes, "layers": stacked_axes, "final_norm": norm_axes}
+
+    # -- forward --
+
+    def _layer_fn(self, lp, h, positions, segment_ids):
+        cfg = self.cfg
+        a_in = L.apply_norm(lp["norm1"], h, cfg)
+        attn_out, _ = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
+                                        inv_freq=self._inv_freq, segment_ids=segment_ids)
+        h = h + attn_out
+        m_in = L.apply_norm(lp["norm2"], h, cfg)
+        if cfg.is_moe:
+            mlp_out, aux = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
+        else:
+            mlp_out, aux = L.apply_mlp(lp["mlp"], m_in, cfg), jnp.zeros((), jnp.float32)
+        return h + mlp_out, aux
+
+    def apply(self, params, input_ids, *, positions=None, segment_ids=None,
+              return_aux_loss=False):
+        """input_ids: (B, S) int32 → logits (B, S, V)."""
+        cfg = self.cfg
+        dt = cfg.act_dtype
+        h = params["embed"]["tok"].astype(dt)[input_ids]
+        if cfg.position == "learned":
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+            h = h + params["embed"]["pos"].astype(dt)[positions]
+
+        def body(carry, lp):
+            h, aux_sum = carry
+            h, aux = self._layer_fn(lp, h, positions, segment_ids)
+            return (h, aux_sum + aux), None
+
+        if cfg.remat != "none":
+            body = jax.checkpoint(body, policy=_remat_policy(cfg.remat))
+
+        (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                                         params["layers"])
+        h = L.apply_norm(params["final_norm"], h, cfg)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
+        else:
+            logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
+        if return_aux_loss:
+            return logits, aux_total / cfg.num_layers
+        return logits
+
+    # -- decode (KV-cache) --
+
+    def init_cache(self, batch_size, max_len, dtype=None):
+        cfg = self.cfg
+        dt = dtype or cfg.act_dtype
+        shape = (batch_size, max_len, cfg.kv_heads, cfg.dims_per_head)
+        zeros = jnp.zeros(shape, dt)
+        return [(zeros, zeros) for _ in range(cfg.num_layers)]
+
+    def apply_decode(self, params, input_ids, cache, cache_len):
+        """Incremental forward: input_ids (B, S_new); returns (logits, cache).
+
+        Decode runs layer-by-layer (unstacked) since each layer mutates its
+        own cache entry; cache is a list of (k, v) per layer.
+        """
+        cfg = self.cfg
+        dt = cfg.act_dtype
+        b, s = input_ids.shape
+        positions = cache_len[:, None] + jnp.arange(s)[None, :]
+        h = params["embed"]["tok"].astype(dt)[input_ids]
+        if cfg.position == "learned":
+            h = h + params["embed"]["pos"].astype(dt)[positions]
+        new_cache = []
+        for i in range(cfg.num_layers):
+            lp = jax.tree.map(lambda x: x[i], params["layers"])
+            a_in = L.apply_norm(lp["norm1"], h, cfg)
+            attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
+                                             inv_freq=self._inv_freq,
+                                             kv_cache=cache[i], cache_len=cache_len)
+            new_cache.append(kv)
+            h = h + attn_out
+            m_in = L.apply_norm(lp["norm2"], h, cfg)
+            if cfg.is_moe:
+                mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
+            else:
+                mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+            h = h + mlp_out
+        h = L.apply_norm(params["final_norm"], h, cfg)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
+        else:
+            logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
+        return logits, new_cache
+
+    # -- loss --
+
+    def loss(self, params, batch):
+        """batch: dict(input_ids (B, S), labels (B, S), optional loss_mask).
+
+        Cross-entropy in fp32 (reference models compute loss in fp32 under
+        fp16 training too); adds MoE aux loss when configured.
+        """
+        cfg = self.cfg
+        logits, aux = self.apply(params, batch["input_ids"],
+                                 positions=batch.get("positions"),
+                                 segment_ids=batch.get("segment_ids"),
+                                 return_aux_loss=True)
+        labels = batch["labels"]
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is None:
+            loss = jnp.mean(nll)
+        else:
+            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if cfg.is_moe:
+            loss = loss + cfg.moe_aux_loss_coef * aux
+        return loss
+
+    def param_count(self):
+        import math
+        return sum(math.prod(x.shape) for x in jax.tree.leaves(self.abstract_params()))
+
+
+def build_model(name_or_cfg, **overrides) -> CausalLM:
+    if isinstance(name_or_cfg, str):
+        return CausalLM(get_config(name_or_cfg, **overrides))
+    if isinstance(name_or_cfg, TransformerConfig):
+        return CausalLM(name_or_cfg.replace(**overrides) if overrides else name_or_cfg)
+    raise TypeError(f"build_model expects preset name or TransformerConfig, got {type(name_or_cfg)}")

@@ -1,0 +1,109 @@
+"""Experiment monitoring fan-out.
+
+Analog of ``deepspeed/monitor/monitor.py:30`` (MonitorMaster → TensorBoard /
+W&B / CSV / Comet). Events are ``(tag, value, step)`` triples written from
+rank 0 only.
+"""
+
+import csv
+import os
+from typing import List, Tuple
+
+import jax
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.config = config
+        self.enabled = getattr(config, "enabled", False)
+
+    def write_events(self, events: List[Event]):
+        raise NotImplementedError
+
+
+class CSVMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = config.enabled and jax.process_index() == 0
+        if self.enabled:
+            self.output_path = config.output_path or "./csv_monitor"
+            self.job_name = config.job_name
+            os.makedirs(os.path.join(self.output_path, self.job_name), exist_ok=True)
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            fname = os.path.join(self.output_path, self.job_name,
+                                 tag.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", tag])
+                w.writerow([step, float(value)])
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = False
+        if config.enabled and jax.process_index() == 0:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                path = os.path.join(config.output_path or "./tensorboard", config.job_name)
+                self.writer = SummaryWriter(log_dir=path)
+                self.enabled = True
+            except Exception as e:
+                logger.warning(f"TensorBoard unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.writer.add_scalar(tag, float(value), step)
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = False
+        if config.enabled and jax.process_index() == 0:
+            try:
+                import wandb
+                wandb.init(project=config.project, group=config.group, entity=config.team)
+                self.wandb = wandb
+                self.enabled = True
+            except Exception as e:
+                logger.warning(f"wandb unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.wandb.log({tag: float(value)}, step=step)
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, monitor_config):
+        super().__init__(monitor_config)
+        self.monitors = []
+        if monitor_config is None:
+            self.enabled = False
+            return
+        if monitor_config.tensorboard.enabled:
+            self.monitors.append(TensorBoardMonitor(monitor_config.tensorboard))
+        if monitor_config.csv_monitor.enabled:
+            self.monitors.append(CSVMonitor(monitor_config.csv_monitor))
+        if monitor_config.wandb.enabled:
+            self.monitors.append(WandbMonitor(monitor_config.wandb))
+        self.enabled = any(m.enabled for m in self.monitors)
+
+    def write_events(self, events: List[Event]):
+        for m in self.monitors:
+            m.write_events(events)

@@ -1,0 +1,112 @@
+"""Attention op dispatch.
+
+Single call site for all models: picks the best implementation for the
+platform (Pallas flash attention on TPU, fused-einsum reference path on CPU),
+the way the reference routes attention through op builders
+(``deepspeed/ops/transformer/inference/ds_attention.py``).
+
+Ulysses sequence parallelism (reference ``deepspeed/sequence/layer.py:145``)
+is expressed here as sharding constraints: activations arrive sequence-sharded
+``P(batch, 'seq', ...)``; constraining q/k/v to head-sharded
+``P(batch, None, 'seq', None)`` makes XLA emit exactly the all-to-all that
+``_SeqAllToAll`` hand-codes, riding ICI.
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import groups
+
+
+def _use_pallas() -> bool:
+    import os
+    if os.environ.get("DS_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    return jax.default_backend() == "tpu"
+
+
+def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None):
+    """Plain XLA attention: (B, S, H, D) x (B, S, KVH, D) -> (B, S, H, D).
+
+    Handles GQA by repeating kv heads. fp32 softmax for stability.
+    """
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
+    sk = k.shape[1]
+    if causal:
+        q_pos = jnp.arange(sq)[:, None] + (sk - sq)
+        k_pos = jnp.arange(sk)[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B, Sq, Sk)
+        logits = jnp.where(seg_mask[:, None, :, :], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
+                        impl: Optional[str] = None):
+    """Dispatching attention entry point.
+
+    q: (B, S, H, D); k/v: (B, S, KVH, D). Returns (B, S, H, D).
+    impl: None (auto) | "reference" | "flash" | "ulysses"
+    """
+    mesh = groups.get_mesh() if groups.mesh_is_initialized() else None
+    seq_sharded = mesh is not None and mesh.shape.get("seq", 1) > 1
+
+    if seq_sharded:
+        # Ulysses: swap sequence-sharding for head-sharding around the local
+        # attention; the constraints lower to all-to-all over the seq axis.
+        head_spec = P(("data", "expert"), None, "seq", None)
+        out_spec = P(("data", "expert"), "seq", None, None)
+        q = jax.lax.with_sharding_constraint(q, jax.NamedSharding(mesh, head_spec))
+        k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
+        v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
+
+    if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
+                           q.shape[3] in (64, 128, 256) and bias is None):
+        try:
+            from .pallas.flash_attention import flash_attention
+            out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+        except Exception:
+            out = reference_attention(q, k, v, causal=causal, bias=bias,
+                                      segment_ids=segment_ids, scale=scale)
+    else:
+        out = reference_attention(q, k, v, causal=causal, bias=bias,
+                                  segment_ids=segment_ids, scale=scale)
+
+    if seq_sharded:
+        out = jax.lax.with_sharding_constraint(out, jax.NamedSharding(mesh, out_spec))
+    return out
+
+
+def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
+    """Single-token decode attention against a (B, S_max, KVH, D) KV cache.
+
+    q: (B, 1, H, D). ``cache_len``: (B,) int32 number of valid cache slots.
+    """
+    b, _, h, d = q.shape
+    kvh = k_cache.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k_cache = jnp.repeat(k_cache, rep, axis=2)
+        v_cache = jnp.repeat(v_cache, rep, axis=2)
+    scale = scale if scale is not None else d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(k_cache.shape[1])[None, :] < cache_len[:, None]  # (B, S_max)
+    logits = jnp.where(mask[:, None, None, :], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)

@@ -1,0 +1,131 @@
+"""Checkpoint engines.
+
+Analog of ``deepspeed/runtime/checkpoint_engine/checkpoint_engine.py:9``
+(CheckpointEngine iface: create/save/load/commit) with an Orbax backend
+(sharded, optionally async — the Nebula-async analog) and a plain-numpy
+fallback for environments without orbax.
+"""
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ...utils.logging import logger
+
+
+class CheckpointEngine:
+    def __init__(self, config_params=None):
+        pass
+
+    def create(self, tag):
+        pass
+
+    def save(self, state_dict, path: str):
+        raise NotImplementedError
+
+    def load(self, path: str, template=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        return True
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    """Sharded save/load via orbax; async when requested (Nebula analog)."""
+
+    def __init__(self, async_save: bool = False):
+        super().__init__()
+        self.async_save = async_save
+        try:
+            import orbax.checkpoint as ocp
+            self._ocp = ocp
+        except Exception as e:  # pragma: no cover
+            logger.warning(f"orbax unavailable ({e}); falling back to numpy engine")
+            self._ocp = None
+            self._fallback = NumpyCheckpointEngine()
+
+    def save(self, state: Dict[str, Any], path: str):
+        if self._ocp is None:
+            return self._fallback.save(state, path)
+        path = os.path.abspath(path)
+        meta = state.pop("meta", None)
+        ckptr = self._ocp.StandardCheckpointer()
+        ckptr.save(path, state, force=True)
+        if not self.async_save:
+            ckptr.wait_until_finished()
+        else:
+            self._pending = ckptr
+        if meta is not None:
+            state["meta"] = meta
+            if jax.process_index() == 0:
+                ckptr.wait_until_finished()
+                with open(os.path.join(path, "ds_meta.json"), "w") as f:
+                    json.dump(meta, f)
+        return True
+
+    def load(self, path: str, template: Optional[Dict[str, Any]] = None):
+        if self._ocp is None:
+            return self._fallback.load(path, template)
+        path = os.path.abspath(path)
+        ckptr = self._ocp.StandardCheckpointer()
+        abstract = {}
+        for key, (value, shardings) in (template or {}).items():
+            if shardings is None:
+                abstract[key] = jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype
+                                                   if not hasattr(x, "dtype") else x.dtype), value)
+            else:
+                abstract[key] = jax.tree.map(
+                    lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),
+                    value, shardings,
+                    is_leaf=lambda x: hasattr(x, "shape") and not isinstance(x, dict))
+        state = ckptr.restore(path, abstract)
+        meta_path = os.path.join(path, "ds_meta.json")
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                state["meta"] = json.load(f)
+        else:
+            state["meta"] = {}
+        return state
+
+    def commit(self, tag):
+        if self._ocp is not None and getattr(self, "_pending", None) is not None:
+            self._pending.wait_until_finished()
+            self._pending = None
+        return True
+
+
+class NumpyCheckpointEngine(CheckpointEngine):
+    """Host-gathered numpy checkpoint (TorchCheckpointEngine analog) — single
+    process only; multi-host should use orbax."""
+
+    def save(self, state: Dict[str, Any], path: str):
+        os.makedirs(path, exist_ok=True)
+        meta = state.get("meta")
+        arrays = {k: v for k, v in state.items() if k != "meta"}
+        flat, treedef = jax.tree.flatten(arrays)
+        np.savez(os.path.join(path, "state.npz"),
+                 **{f"arr_{i}": np.asarray(jax.device_get(x)) for i, x in enumerate(flat)})
+        with open(os.path.join(path, "treedef.pkl"), "wb") as f:
+            pickle.dump(jax.tree.structure(arrays), f)
+        if meta is not None:
+            with open(os.path.join(path, "ds_meta.json"), "w") as f:
+                json.dump(meta, f)
+        return True
+
+    def load(self, path: str, template=None):
+        data = np.load(os.path.join(path, "state.npz"))
+        with open(os.path.join(path, "treedef.pkl"), "rb") as f:
+            treedef = pickle.load(f)
+        flat = [data[f"arr_{i}"] for i in range(len(data.files))]
+        state = jax.tree.unflatten(treedef, flat)
+        meta_path = os.path.join(path, "ds_meta.json")
+        state["meta"] = {}
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                state["meta"] = json.load(f)
+        return state

@@ -56,10 +56,11 @@ class DeepSpeedSchedulerConfig(DeepSpeedConfigModel):
 class MeshConfig(DeepSpeedConfigModel):
     """TPU device mesh layout. Any axis may be "auto" (resolved at init).
 
-    Axis order is (pipe, data, seq, expert_inner, tensor) — outer axes map to
-    DCN/slower links, inner axes to ICI, following the scaling-book recipe.
-    ``data`` doubles as the ZeRO/FSDP sharding axis (the reference shards ZeRO
-    state over the DP group the same way).
+    Axis order is (pipe, data, expert, seq, tensor) — matching
+    ``utils.groups.MESH_AXIS_ORDER``: outer axes map to DCN/slower links,
+    inner axes to ICI, following the scaling-book recipe. ``data`` doubles as
+    the ZeRO/FSDP sharding axis (the reference shards ZeRO state over the DP
+    group the same way).
     """
     data: Union[int, str] = -1  # -1 → fill with remaining devices
     tensor: int = Field(1, ge=1)

@@ -1,0 +1,100 @@
+"""Loss scaling for fp16 training.
+
+Analog of ``deepspeed/runtime/fp16/loss_scaler.py`` (LossScaler /
+DynamicLossScaler). The reference checks overflow on the host and skips
+``optimizer.step``; here the scaler state lives *inside* the jitted train
+step as a small pytree and the skip is a ``jnp.where`` select — no host
+round-trip, no recompilation (reference overflow semantics:
+``engine.py:2150-2157``).
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 consecutive overflow-free steps
+    hysteresis: jnp.ndarray     # i32 remaining tolerated overflows before backoff
+    overflows: jnp.ndarray      # i32 total skipped steps (telemetry)
+
+
+class DynamicLossScaler:
+    """Stateless policy object producing/updating LossScaleState."""
+
+    def __init__(self, init_scale=2 ** 16, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False):
+        self.init_scale = float(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = bool(consecutive_hysteresis)
+
+    def init_state(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.asarray(self.init_scale, jnp.float32),
+                              good_steps=jnp.zeros((), jnp.int32),
+                              hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+                              overflows=jnp.zeros((), jnp.int32))
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        """Pure update given a bool overflow flag (traced)."""
+        hysteresis_spent = jnp.where(overflow, state.hysteresis - 1, state.hysteresis)
+        do_backoff = overflow & (hysteresis_spent <= 0)
+        new_scale = jnp.where(
+            do_backoff,
+            jnp.maximum(state.scale / self.scale_factor, self.min_scale),
+            state.scale)
+        window_full = (state.good_steps + 1) >= self.scale_window
+        grow = (~overflow) & window_full
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        new_good = jnp.where(overflow | grow, 0, state.good_steps + 1)
+        reset_h = jnp.asarray(self.delayed_shift, jnp.int32)
+        if self.consecutive_hysteresis:
+            new_h = jnp.where(overflow, jnp.maximum(hysteresis_spent, 0), reset_h)
+        else:
+            new_h = jnp.where(do_backoff, reset_h, jnp.where(overflow, hysteresis_spent, state.hysteresis))
+        return LossScaleState(scale=new_scale.astype(jnp.float32),
+                              good_steps=new_good.astype(jnp.int32),
+                              hysteresis=new_h.astype(jnp.int32),
+                              overflows=(state.overflows + overflow.astype(jnp.int32)))
+
+
+class StaticLossScaler:
+    def __init__(self, scale=1.0):
+        self.scale = float(scale)
+
+    def init_state(self) -> LossScaleState:
+        return LossScaleState(scale=jnp.asarray(self.scale, jnp.float32),
+                              good_steps=jnp.zeros((), jnp.int32),
+                              hysteresis=jnp.ones((), jnp.int32),
+                              overflows=jnp.zeros((), jnp.int32))
+
+    def update(self, state: LossScaleState, overflow) -> LossScaleState:
+        return state._replace(overflows=state.overflows + overflow.astype(jnp.int32))
+
+
+def has_overflow(grads) -> jnp.ndarray:
+    """True if any grad element is non-finite (reference CheckOverflow)."""
+    leaves = jax.tree.leaves(grads)
+    flags = [jnp.logical_not(jnp.all(jnp.isfinite(g.astype(jnp.float32)))) for g in leaves]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out | f
+    return out
+
+
+def create_loss_scaler(fp16_config=None, dtype=None):
+    """Factory following ``runtime/engine.py`` scaler selection."""
+    import jax.numpy as jnp_
+    if fp16_config is None or not fp16_config.enabled or dtype != jnp_.float16:
+        return StaticLossScaler(1.0)
+    if fp16_config.dynamic_loss_scale:
+        return DynamicLossScaler(init_scale=2 ** fp16_config.initial_scale_power,
+                                 scale_window=fp16_config.loss_scale_window,
+                                 min_scale=fp16_config.min_loss_scale,
+                                 delayed_shift=fp16_config.hysteresis,
+                                 consecutive_hysteresis=fp16_config.consecutive_hysteresis)
+    return StaticLossScaler(fp16_config.loss_scale)

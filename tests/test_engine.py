@@ -1,0 +1,170 @@
+"""Engine integration tests (reference pattern: tests/unit/runtime/test_ds_initialize.py,
+tests/unit/runtime/zero/test_zero.py — ZeRO stages must be numerically
+equivalent to plain DP)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.utils import groups
+
+
+def _base_config(stage=0, **over):
+    cfg = {
+        "train_batch_size": 16,
+        "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "gradient_clipping": 1.0,
+        "steps_per_print": 10 ** 9,
+        "seed": 7,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _make_batch(seed=0, bs=16, seq=32, vocab=256):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, vocab, (bs, seq))
+    return {"input_ids": ids, "labels": ids}
+
+
+def _train(stage, steps=4, preset="tiny"):
+    groups.reset_mesh()
+    model = build_model(preset)
+    engine, _, _, _ = ds.initialize(model=model, config=_base_config(stage))
+    losses = [float(engine.train_batch(_make_batch(seed=i))) for i in range(steps)]
+    return losses, engine
+
+
+def test_train_loss_decreases_on_memorization(mesh_8dp):
+    """Repeating one batch must drive loss down (training is real)."""
+    model = build_model("tiny")
+    engine, _, _, _ = ds.initialize(model=model, config=_base_config(0))
+    batch = _make_batch(seed=42)
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.8, losses
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stages_match_dp(stage):
+    """ZeRO sharding must not change numerics vs stage 0 (pure DP)."""
+    ref, _ = _train(0)
+    got, engine = _train(stage)
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
+    # params actually sharded at stage 3
+    if stage == 3:
+        tok = engine.module_params["embed"]["tok"]
+        assert not tok.sharding.is_fully_replicated
+
+
+def test_opt_state_sharded_stage1():
+    _, engine = _train(1, steps=1)
+    slot = engine.opt_state["slots"]["embed"]["tok"]["m"]
+    assert not slot.sharding.is_fully_replicated
+    # params stay replicated at stage 1
+    assert engine.module_params["embed"]["tok"].sharding.is_fully_replicated
+
+
+def test_forward_backward_step_equals_train_batch():
+    """Decomposed API must produce the same update as the fused path."""
+    ref_losses, ref_engine = _train(0, steps=2)
+
+    groups.reset_mesh()
+    model = build_model("tiny")
+    engine, _, _, _ = ds.initialize(model=model, config=_base_config(0))
+    for i in range(2):
+        full = _make_batch(seed=i)
+        gas, mb = 2, 8  # 16 = gas * (1 micro/gpu * 8 devices)
+        for g in range(gas):
+            sl = {k: v[g * mb:(g + 1) * mb] for k, v in full.items()}
+            loss = engine.forward(sl)
+            engine.backward(loss)
+            engine.step()
+    ref_tok = np.asarray(ref_engine.module_params["embed"]["tok"])
+    got_tok = np.asarray(engine.module_params["embed"]["tok"])
+    np.testing.assert_allclose(ref_tok, got_tok, rtol=1e-4, atol=1e-5)
+
+
+def test_fp16_overflow_skips_step():
+    groups.reset_mesh()
+    model = build_model("tiny")
+    cfg = _base_config(0, fp16={"enabled": True, "initial_scale_power": 4, "hysteresis": 1})
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    p_before = np.asarray(engine.module_params["embed"]["tok"]).copy()
+    # poison gradients through a huge loss-scale overflow: feed inf-producing batch
+    # by injecting inf grads directly via the update fn contract
+    inf_grads = jax.tree.map(lambda p: jnp.full(p.shape, jnp.inf, jnp.float32),
+                             engine.module_params)
+    engine._acc_grads = inf_grads
+    engine._acc_count = 1
+    engine.micro_steps = engine.gradient_accumulation_steps() - 0  # at boundary
+    engine.step()
+    p_after = np.asarray(engine.module_params["embed"]["tok"])
+    np.testing.assert_array_equal(p_before, p_after)
+    assert float(engine.scaler_state.scale) < 2 ** 4  # backed off
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    losses, engine = _train(2, steps=2)
+    engine.save_checkpoint(str(tmp_path), tag="t1")
+    before = np.asarray(engine.module_params["embed"]["tok"]).copy()
+    step_before = engine.global_steps
+
+    # train further, then restore
+    engine.train_batch(_make_batch(seed=99))
+    assert not np.allclose(before, np.asarray(engine.module_params["embed"]["tok"]))
+    engine.load_checkpoint(str(tmp_path), tag="t1")
+    np.testing.assert_array_equal(before, np.asarray(engine.module_params["embed"]["tok"]))
+    assert engine.global_steps == step_before
+
+
+def test_checkpoint_latest_file(tmp_path):
+    _, engine = _train(0, steps=1)
+    engine.save_checkpoint(str(tmp_path))
+    import os
+    assert os.path.isfile(os.path.join(str(tmp_path), "latest"))
+    path, _ = engine.load_checkpoint(str(tmp_path))
+    assert path is not None
+
+
+def test_lr_schedule_integration():
+    groups.reset_mesh()
+    model = build_model("tiny")
+    cfg = _base_config(0)
+    cfg["scheduler"] = {"type": "WarmupLR", "params": {"warmup_num_steps": 10,
+                                                       "warmup_max_lr": 1e-3,
+                                                       "warmup_type": "linear"}}
+    engine, _, _, sched = ds.initialize(model=model, config=cfg)
+    engine.train_batch(_make_batch())
+    lr1 = engine.get_lr()[0]
+    engine.train_batch(_make_batch())
+    lr2 = engine.get_lr()[0]
+    assert lr2 > lr1  # warming up
+
+
+def test_tensor_parallel_forward(mesh_2x4):
+    """TP=4: params sharded over tensor axis, loss still finite & correct shape."""
+    model = build_model("tiny")
+    config = _base_config(0)
+    config["train_batch_size"] = 4
+    config["train_micro_batch_size_per_gpu"] = 1
+    config["gradient_accumulation_steps"] = 2
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    wq = engine.module_params["layers"]["attn"]["wq"]
+    assert not wq.sharding.is_fully_replicated  # heads dim sharded over tensor
+    loss = engine.train_batch(_make_batch(bs=4))
+    assert np.isfinite(float(loss))
+
+
+def test_moe_training(mesh_8dp):
+    model = build_model("tiny-moe")
+    engine, _, _, _ = ds.initialize(model=model, config=_base_config(1))
+    batch = _make_batch(seed=3)
+    losses = [float(engine.train_batch(batch)) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
