@@ -1,0 +1,274 @@
+"""Pallas flash attention (training) for TPU.
+
+Replaces the reference's CUDA fused-attention kernels
+(``csrc/transformer/inference/csrc/softmax_context`` and the training
+transformer kernel, SURVEY.md §2.2): FlashAttention-2-style online-softmax
+tiling sized for the MXU, fp32 accumulation, causal block skipping, GQA via
+block index maps (kv heads are never materialized per-q-head in HBM).
+
+Layout inside the kernel: (B, H, S, D). The public wrapper takes the model's
+(B, S, H, D) and transposes (free under XLA fusion).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # Mosaic compiles only on TPU; anywhere else run the kernel interpreted
+    # (slow but exact) so tests exercise the same code path.
+    return jax.default_backend() != "tpu"
+
+
+# ----------------------------------------------------------------------
+# forward
+# ----------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0]                                      # (Bq, D) input dtype
+    seq_k = k_ref.shape[2]
+    num_kv = seq_k // block_k
+    if causal:
+        # last kv block that intersects rows [qi*Bq, (qi+1)*Bq)
+        kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
+    else:
+        kv_hi = num_kv
+
+    def body(j, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]                       # (Bk, D)
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale   # (Bq, Bk)
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1))
+        alpha = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        l_new = l * alpha + jnp.sum(p, axis=1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, kv_hi, body, (m0, l0, acc0))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, 0] = m + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    grid = (b, h, sq // block_q)
+    group = h // kvh
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ----------------------------------------------------------------------
+# backward
+# ----------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0]
+    do = do_ref[0, 0]
+    lse = lse_ref[0, 0, 0]
+    delta = delta_ref[0, 0, 0]
+    seq_k = k_ref.shape[2]
+    num_kv = seq_k // block_k
+    if causal:
+        kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
+    else:
+        kv_hi = num_kv
+
+    def body(j, dq):
+        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                                       # (Bq, Bk)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, kv_hi, body,
+                           jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
+                scale, causal, block_q, block_k):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0]                                       # (Bk, D)
+    v = v_ref[0, 0]
+    seq_q = q_ref.shape[2]
+    num_q = seq_q // block_q
+    q_lo = (ki * block_k) // block_q if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
+        lse = lse_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
+        delta = delta_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
+        if causal:
+            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                          preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    zeros = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(q_lo, num_q, body, (zeros, zeros))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, residuals, g):
+    q, k, v, out, lse = residuals
+    b, h, sq, d = q.shape
+    kvh = k.shape[1]
+    group = h // kvh
+    do = g
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1)[:, :, None, :]  # (B,H,1,Sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, sq // block_q),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    sk = k.shape[2]
+    dk_h, dv_h = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(b, h, sk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki_: (bi, hi // group, ki_, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki_: (bi, hi // group, ki_, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki_: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki_: (bi, hi, ki_, 0)),
+            pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki_: (bi, hi, ki_, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    if group > 1:
+        dk = dk_h.reshape(b, kvh, group, sk, d).sum(axis=2).astype(k.dtype)
+        dv = dv_h.reshape(b, kvh, group, sk, d).sum(axis=2).astype(v.dtype)
+    else:
+        dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# public API
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
+                    block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q: (B, S, H, D); k/v: (B, S, KVH, D) → (B, S, H, D).
+
+    Requires S % block == 0 and D in {64, 128, 256}; callers
+    (``ops/attention.py``) fall back to the XLA path otherwise.
+    """
+    if segment_ids is not None:
+        raise NotImplementedError("flash_attention: segment_ids not supported; use reference path")
+    b, s, h, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q != 0 or s % block_k != 0:
+        raise ValueError(f"seq len {s} not divisible by blocks ({block_q},{block_k})")
+    scale = scale if scale is not None else d ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), int(block_q), int(block_k))
+    return out.transpose(0, 2, 1, 3)
