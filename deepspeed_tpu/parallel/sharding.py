@@ -55,7 +55,7 @@ BASE_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
     ("expert", "expert"),
     ("embed", None),
     ("head_dim", None),
-    ("layers", None),
+    ("layers", "pipe"),   # stage-sharded layer stack when pipeline parallel
     ("unmodeled", None),
 )
 

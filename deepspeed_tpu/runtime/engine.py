@@ -255,6 +255,10 @@ class DeepSpeedEngine:
 
     def _compile_step_fns(self):
         mesh = self.mesh
+        self.pipe_parallel_size = mesh.shape["pipe"]
+        if self.pipe_parallel_size > 1:
+            self._compile_pipeline_step_fns()
+            return
 
         @functools.partial(jax.jit,
                            out_shardings=(self._replicated, self.grad_shardings))
@@ -299,6 +303,45 @@ class DeepSpeedEngine:
         self._update_fn = update_fn
         self._train_step_fn = train_step_fn
 
+    def _compile_pipeline_step_fns(self):
+        """Pipeline-parallel step: the gas microbatches feed the pipe ring
+        (reference PipelineEngine.train_batch:337); forward/backward are
+        fused — the decomposed API raises, as in the reference (engine.py:61
+        PipelineEngine forbids separate forward/backward)."""
+        from ..models.transformer import CausalLM
+        from .pipe.engine import build_pipeline_loss
+        assert isinstance(self.model, CausalLM), \
+            "pipeline parallelism currently requires a native CausalLM model"
+        ploss = build_pipeline_loss(self.model, self.pipe_parallel_size)
+
+        @functools.partial(
+            jax.jit,
+            donate_argnums=(0, 1, 2),
+            static_argnames=("gas",),
+            out_shardings=(self.param_shardings, self.opt_state_shardings, None,
+                           self._replicated, self._replicated, self._replicated))
+        def train_step_fn(params, opt_state, scaler_state, batch, lr, gas):
+            scale = scaler_state.scale
+
+            def scaled(p):
+                return ploss(p, batch) * scale
+
+            loss, grads = jax.value_and_grad(scaled)(params)
+            grads = jax.tree.map(lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                                 grads, self.grad_shardings)
+            new_params, new_opt, new_scaler, overflow, grad_norm = self._apply_update(
+                params, opt_state, scaler_state, grads, lr, jnp.float32(1.0))
+            return new_params, new_opt, new_scaler, loss / scale, overflow, grad_norm
+
+        self._train_step_fn = train_step_fn
+        self._grad_fn = None
+        self._update_fn = None
+
+    def _assert_not_pipeline(self, api):
+        if getattr(self, "pipe_parallel_size", 1) > 1:
+            raise RuntimeError(f"{api}() is not supported with pipeline parallelism; "
+                               "use train_batch() (reference PipelineEngine semantics)")
+
     # ------------------------------------------------------------------
     # public API (reference parity)
     # ------------------------------------------------------------------
@@ -336,6 +379,7 @@ class DeepSpeedEngine:
         """Compute loss (and cache grads for the paired backward)."""
         if batch is None:
             batch = kwargs
+        self._assert_not_pipeline("forward")
         self.timers(FORWARD_GLOBAL_TIMER).start()
         batch = self._put_batch(batch)
         loss, grads = self._grad_fn(self.module_params, batch, self.scaler_state.scale)

@@ -1,0 +1,146 @@
+"""Compiled pipeline-parallel execution.
+
+Analog of ``deepspeed/runtime/pipe/engine.py:61`` (PipelineEngine) +
+``pipe/p2p.py``. The reference walks an instruction stream
+(``_exec_schedule:1408``), hand-managing p2p sends/recvs and buffers. Here
+the WHOLE pipeline — fill, steady state, drain — is one ``lax.scan`` inside
+a ``shard_map`` manual over the ``pipe`` mesh axis:
+
+- stage handoff is ``ppermute`` (+1 ring over ICI) — the p2p layer;
+- autodiff of the scan+ppermute emits the reverse ring: the backward
+  pipeline falls out of ``jax.grad`` instead of RecvGrad/SendGrad plumbing;
+- the tensor-meta handshake (reference ``:928``) is unnecessary: shapes are
+  static contracts of the compiled program.
+
+Schedule shape = GPipe fill-drain over M microbatches (bubble (P-1)/(M+P-1),
+same as 1F1B; 1F1B's memory advantage is recovered with per-stage remat).
+"""
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...utils import groups
+
+
+def _pvary(x, axis):
+    """Mark a replicated value as varying over ``axis`` (vma typing)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
+
+
+def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
+                  remat: bool = True):
+    """Build ``run(stacked_layer_params, stream) -> outputs`` executing
+    ``layer_fn`` over a ``pipe``-sharded layer stack.
+
+    - ``stacked_layer_params``: pytree with leading dim L = P * layers_per_stage,
+      sharded over "pipe" on dim 0.
+    - ``stream``: (M, ...) microbatch activations, replicated over "pipe".
+    - ``layer_fn(layer_params, x) -> y`` single-layer forward (x, y same shape).
+
+    Returns outputs (M, ...) — the last stage's results, replicated over
+    "pipe" (via masked psum).
+    """
+    mesh = groups.get_mesh()
+
+    def per_stage(stage_layers, stream):
+        # stage_layers: (layers_per_stage, ...); stream: (M, mb...) replicated
+        stage = jax.lax.axis_index("pipe")
+        m = stream.shape[0]
+        ticks = m + num_stages - 1
+
+        def run_stage(layers_params, x):
+            def one(h, lp):
+                return layer_fn(lp, h), None
+            y, _ = jax.lax.scan(one, x, layers_params)
+            return y
+
+        if remat:
+            run_stage = jax.checkpoint(run_stage)
+
+        def tick(carry, t):
+            act, buf = carry
+            mb_idx = jnp.clip(t, 0, m - 1)
+            x_new = jax.lax.dynamic_index_in_dim(stream, mb_idx, axis=0, keepdims=False)
+            x = jnp.where(stage == 0, _pvary(x_new, "pipe"), act)
+            y = run_stage(stage_layers, x)
+            out_idx = jnp.clip(t - (num_stages - 1), 0, m - 1)
+            is_out = (stage == num_stages - 1) & (t >= num_stages - 1)
+            cur = jax.lax.dynamic_index_in_dim(buf, out_idx, axis=0, keepdims=False)
+            upd = jnp.where(is_out, y, cur)
+            buf = jax.lax.dynamic_update_index_in_dim(buf, upd, out_idx, axis=0)
+            perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+            act_next = jax.lax.ppermute(y, "pipe", perm)
+            return (act_next, buf), None
+
+        act0 = jnp.zeros(stream.shape[1:], stream.dtype)
+        act0 = _pvary(act0, "pipe")
+        buf0 = _pvary(jnp.zeros_like(stream), "pipe")
+        (act, buf), _ = jax.lax.scan(tick, (act0, buf0), jnp.arange(ticks))
+        # replicate last stage's buffer to every stage
+        mask = (stage == num_stages - 1).astype(buf.dtype)
+        return jax.lax.psum(buf * mask, "pipe")
+
+    # manual over pipe only; data/tensor/... axes stay automatic (handled by
+    # the outer jit shardings).
+    return jax.shard_map(per_stage, mesh=mesh,
+                         in_specs=(P("pipe"), P()),
+                         out_specs=P(),
+                         axis_names={"pipe"},
+                         check_vma=True)
+
+
+def build_pipeline_loss(model, num_stages: int):
+    """Pipelined loss for a CausalLM: embed → pipe(layer stack) → head/CE.
+
+    batch leaves are (M, mb, S) — M pipeline microbatches.
+    """
+    from ...models import layers as L
+    cfg = model.cfg
+    assert cfg.num_layers % num_stages == 0, \
+        f"num_layers={cfg.num_layers} not divisible by pipe={num_stages}"
+    layers_per_stage = cfg.num_layers // num_stages
+
+    def layer_fn(lp, h):
+        h, _ = model._layer_fn(lp, h, None, None)
+        return h
+
+    pipe_run = pipeline_spmd(layer_fn, num_stages, layers_per_stage,
+                             remat=(cfg.remat != "none") or True)
+
+    def loss_fn(params, batch):
+        ids = batch["input_ids"]          # (M, mb, S)
+        labels = batch["labels"]
+        m, mb, s = ids.shape
+        dt = cfg.act_dtype
+        flat_ids = ids.reshape(m * mb, s)
+        h = params["embed"]["tok"].astype(dt)[flat_ids]
+        if cfg.position == "learned":
+            pos = jnp.broadcast_to(jnp.arange(s), (m * mb, s))
+            h = h + params["embed"]["pos"].astype(dt)[pos]
+        h = h.reshape(m, mb, s, cfg.hidden_size)
+
+        h = pipe_run(params["layers"], h)
+
+        h = h.reshape(m * mb, s, cfg.hidden_size)
+        h = L.apply_norm(params["final_norm"], h, cfg)
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
+        else:
+            logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        flat_labels = labels.reshape(m * mb, s)
+        nll = -jnp.take_along_axis(logp, flat_labels[..., None], axis=-1)[..., 0]
+        mask = batch.get("loss_mask")
+        if mask is None:
+            return jnp.mean(nll)
+        mask = mask.reshape(m * mb, s)
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    return loss_fn

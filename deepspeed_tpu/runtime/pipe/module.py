@@ -1,0 +1,103 @@
+"""Pipeline module specification.
+
+Analog of ``deepspeed/runtime/pipe/module.py`` (PipelineModule ``:86``,
+LayerSpec ``:30``, TiedLayerSpec ``:77``). The reference builds a torch
+Sequential cut into stages; here a pipeline is a *sharding declaration* over
+the model's stacked layer dim (see ``pipe/engine.py``), so PipelineModule is
+a thin planner: it validates the partition, exposes stage bookkeeping
+(ownership ranges, parameter counts), and carries the loss function.
+
+Tied weights: the reference's TiedLayerSpec replicates a module across
+stages and allreduces its grads (``pipe/engine.py:275``). In the compiled
+design, tied tensors (e.g. embedding/lm-head) live OUTSIDE the pipe-manual
+region, so XLA's SPMD handles their gradient reduction — TiedLayerSpec is
+accepted and recorded for parity but needs no runtime machinery.
+"""
+
+from typing import Callable, List, Optional
+
+from ...models.config import TransformerConfig
+from ...models.transformer import CausalLM
+from ...utils import groups
+from .schedule import bubble_fraction
+
+
+class LayerSpec:
+    """Deferred layer construction (reference ``module.py:30``)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    def __init__(self, key, typename, *module_args, forward_fn=None, tied_weight_attr="weight",
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """Stage-partition planner over a native CausalLM."""
+
+    def __init__(self, layers=None, num_stages: Optional[int] = None, topology=None,
+                 loss_fn: Optional[Callable] = None, partition_method: str = "uniform",
+                 activation_checkpoint_interval: int = 0, model: Optional[CausalLM] = None):
+        if model is None and isinstance(layers, CausalLM):
+            model, layers = layers, None
+        self.model = model
+        self.layer_specs = list(layers) if layers is not None else []
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        if num_stages is None:
+            num_stages = groups.get_pipe_parallel_world_size() if groups.mesh_is_initialized() else 1
+        self.num_stages = num_stages
+        if model is not None:
+            n = model.cfg.num_layers
+        else:
+            n = len(self.layer_specs)
+        if num_stages > 0 and n % num_stages != 0:
+            raise ValueError(f"{n} layers not divisible into {num_stages} stages "
+                             f"(partition_method={partition_method!r})")
+        self.layers_per_stage = n // max(1, num_stages)
+
+    @classmethod
+    def from_model(cls, model: CausalLM, num_stages: Optional[int] = None):
+        return cls(model=model, num_stages=num_stages)
+
+    def stage_owner(self, layer_idx: int) -> int:
+        return layer_idx // self.layers_per_stage
+
+    def stage_layers(self, stage_id: int):
+        lo = stage_id * self.layers_per_stage
+        return list(range(lo, lo + self.layers_per_stage))
+
+    def bubble(self, micro_batches: int) -> float:
+        return bubble_fraction(micro_batches, self.num_stages)
+
+    # CausalLM passthroughs so engines can treat PipelineModule as a model
+    def init(self, rng):
+        return self.model.init(rng)
+
+    def abstract_params(self):
+        return self.model.abstract_params()
+
+    def logical_axes(self):
+        return self.model.logical_axes()
+
+    def loss(self, params, batch):
+        return self.model.loss(params, batch)
+
+    @property
+    def cfg(self) -> TransformerConfig:
+        return self.model.cfg
