@@ -40,6 +40,8 @@ class TransformerConfig:
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # stored parameter dtype
+    # attention implementation: "auto" | "reference" | "flash" | "ring"
+    attn_impl: str = "auto"
     # remat policy for scan-over-layers ("none"|"full"|"dots")
     remat: str = "none"
 

@@ -129,7 +129,9 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
         new_cache = (ck, cv)
         out = decode_attention(q, ck, cv, cache_len + s)
     else:
-        out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids)
+        impl = None if cfg.attn_impl == "auto" else cfg.attn_impl
+        out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids,
+                                  impl=impl)
 
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if cfg.use_bias:

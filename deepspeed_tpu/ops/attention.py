@@ -67,6 +67,16 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     mesh = groups.get_mesh() if groups.mesh_is_initialized() else None
     seq_sharded = mesh is not None and mesh.shape.get("seq", 1) > 1
 
+    if impl == "ring":
+        from ..sequence.ring_attention import ring_attention
+        if not causal:
+            raise NotImplementedError("ring attention is causal-only")
+        if seq_sharded:
+            return ring_attention(q, k, v, scale=scale)
+        # no seq axis: plain local attention
+        return reference_attention(q, k, v, causal=causal, bias=bias,
+                                   segment_ids=segment_ids, scale=scale)
+
     if seq_sharded:
         # Ulysses: swap sequence-sharding for head-sharding around the local
         # attention; the constraints lower to all-to-all over the seq axis.

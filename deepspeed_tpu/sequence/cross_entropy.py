@@ -1,0 +1,45 @@
+"""Sequence-parallel cross entropy.
+
+Analog of ``deepspeed/sequence/cross_entropy.py:59``
+(vocab_sequence_parallel_cross_entropy): with the sequence dim sharded, each
+rank computes CE on its local tokens; the mean reduces over the seq axis.
+Under jit with seq-sharded logits XLA produces this schedule from the plain
+expression, so the explicit shard_map variant exists for parity and for use
+inside manual regions.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import groups
+
+
+def vocab_sequence_parallel_cross_entropy(logits, labels, axis_name: str = "seq"):
+    """logits: (B, S_local, V) local shard inside shard_map; labels (B, S_local).
+
+    Returns per-rank mean CE psum-averaged over the seq axis.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    local = jnp.mean(nll)
+    return jax.lax.pmean(local, axis_name)
+
+
+def sequence_parallel_cross_entropy(logits, labels, axis_name: str = "seq"):
+    """Eager/jit helper over globally-shaped (seq-sharded) arrays."""
+    mesh = groups.get_mesh()
+    if mesh.shape.get(axis_name, 1) <= 1:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+    batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1) or None
+    lspec = P(batch_axes, axis_name, None)
+    yspec = P(batch_axes, axis_name)
+    all_axes = (axis_name,) + (batch_axes or ())
+    fn = jax.shard_map(
+        lambda lg, lb: vocab_sequence_parallel_cross_entropy(lg, lb, all_axes),
+        mesh=mesh, in_specs=(lspec, yspec), out_specs=P(),
+        axis_names={axis_name} | (set(batch_axes) if batch_axes else set()),
+        check_vma=True)
+    return fn(logits, labels)

@@ -1,0 +1,66 @@
+"""DeepSpeed-Ulysses sequence parallelism.
+
+Analog of ``deepspeed/sequence/layer.py:145`` (DistributedAttention) and
+``single_all_to_all:41`` / ``_SeqAllToAll:90``. The reference scatters heads /
+gathers sequence with an explicit all-to-all autograd op before local
+attention, and inverts it after. On TPU the same exchange is expressed two
+ways, both provided:
+
+- declarative (default): sharding constraints around the local attention
+  (``ops/attention.py``) — XLA lowers the constraint flip seq-sharded →
+  head-sharded to exactly one all-to-all over the ``seq`` ICI axis;
+- explicit: :func:`seq_all_to_all` inside ``shard_map`` for code that wants
+  the reference's manual op (and for the comm benchmark suite).
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import groups
+
+
+def seq_all_to_all(x, axis_name: str = "seq", scatter_idx: int = 2, gather_idx: int = 1):
+    """All-to-all inside shard_map: scatter dim ``scatter_idx`` (heads),
+    gather dim ``gather_idx`` (sequence). Analog of ``single_all_to_all:41``."""
+    return jax.lax.all_to_all(x, axis_name, split_axis=scatter_idx,
+                              concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """Wraps a local attention callable with the Ulysses exchange.
+
+    ``local_attn(q, k, v, *args, **kwargs) -> out`` sees full-sequence,
+    head-sharded tensors; inputs/outputs at the boundary are seq-sharded.
+    API mirror of reference ``DistributedAttention(local_attn, sp_group,
+    scatter_idx, gather_idx)``.
+    """
+
+    def __init__(self, local_attention: Callable, sequence_process_group=None,
+                 scatter_idx: int = 2, gather_idx: int = 1,
+                 sp_stream=None):
+        self.local_attn = local_attention
+        self.spg = sequence_process_group or ("seq",)
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        mesh = groups.get_mesh()
+        axis = self.spg[0] if isinstance(self.spg, (tuple, list)) else self.spg
+        if mesh.shape.get(axis, 1) <= 1:
+            return self.local_attn(query, key, value, *args, **kwargs)
+
+        batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1) or None
+        seq_spec = P(batch_axes, axis, None, None)     # (B, S/sp, H, D)
+        head_spec = P(batch_axes, None, axis, None)    # (B, S, H/sp, D)
+
+        def constrain(x, spec):
+            return jax.lax.with_sharding_constraint(x, jax.NamedSharding(mesh, spec))
+
+        q = constrain(query, head_spec)
+        k = constrain(key, head_spec)
+        v = constrain(value, head_spec)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        return constrain(out, seq_spec)

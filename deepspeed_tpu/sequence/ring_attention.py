@@ -1,0 +1,106 @@
+"""Ring attention: context parallelism over the ``seq`` mesh axis.
+
+The reference has no ring attention (SURVEY.md §2.3 marks CP absent; Ulysses
+is its long-sequence answer), but the TPU torus makes ring CP the idiomatic
+long-context mechanism: each rank holds a sequence shard of Q/K/V, K/V blocks
+rotate around the ring via ``ppermute`` while flash-style online-softmax
+statistics (m, l, acc) merge partial results — peak memory stays O(S/n) per
+chip and comm rides neighbor ICI links only.
+
+Causal masking per ring step: a KV block originating from rank r is fully
+visible to Q ranks p > r, causally visible at p == r, invisible at p < r.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils import groups
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, scale, mode):
+    """Partial (unnormalized) attention of local q against one kv block.
+
+    mode: 0 = skip (masked), 1 = causal (diagonal block), 2 = full.
+    Returns (m, l, o_partial): rowmax, rowsum, weighted values.
+    q: (B, Sq, H, D); k/v: (B, Sk, KVH, D).
+    """
+    b, sq, h, d = q.shape
+    kvh = k.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
+    sk = k.shape[1]
+    causal_mask = (jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :])[None, None]
+    mask = jnp.where(mode == 1, causal_mask, mode == 2)
+    s = jnp.where(mask, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # (B, H, Sq)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(mask, p, 0.0)                               # kill exp(NEG_INF - NEG_INF)
+    l = jnp.sum(p, axis=-1)                                   # (B, H, Sq)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)   # (B, Sq, H, D)
+    return m, l, o.astype(jnp.float32)
+
+
+def _ring_body(q, k, v, axis_name, scale, vary_axes=None):
+    """Runs on one rank inside shard_map: q/k/v are local seq shards."""
+    n = jax.lax.axis_size(axis_name)
+    p_idx = jax.lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+
+    def step(i, carry):
+        m_acc, l_acc, o_acc, kv = carry
+        k_blk, v_blk = kv
+        src = (p_idx - i) % n        # rank that produced this kv block
+        mode = jnp.where(src == p_idx, 1, jnp.where(src < p_idx, 2, 0))
+        m_b, l_b, o_b = _block_attend(q, k_blk, v_blk, scale, mode)
+        m_new = jnp.maximum(m_acc, m_b)
+        a_old = jnp.exp(m_acc - m_new)
+        a_new = jnp.exp(m_b - m_new)
+        l_new = l_acc * a_old + l_b * a_new
+        o_new = (o_acc * jnp.moveaxis(a_old, 1, -1)[..., None] +
+                 o_b * jnp.moveaxis(a_new, 1, -1)[..., None])
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kv_next = (jax.lax.ppermute(k_blk, axis_name, perm),
+                   jax.lax.ppermute(v_blk, axis_name, perm))
+        return m_new, l_new, o_new, kv_next
+
+    axes = tuple(vary_axes) if vary_axes else (axis_name,)
+
+    def _vary(x):
+        if hasattr(jax.lax, "pcast"):
+            return jax.lax.pcast(x, axes, to="varying")
+        return jax.lax.pvary(x, axes)
+
+    m0 = _vary(jnp.full((b, h, sq), NEG_INF, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
+    o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
+    step = jax.checkpoint(step, static_argnums=())
+    m, l, o, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, (k, v)))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    return (o / jnp.moveaxis(l_safe, 1, -1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None):
+    """Causal ring attention. q/k/v: (B, S, H|KVH, D) GLOBAL logical shapes,
+    seq-sharded over ``axis_name``. Returns (B, S, H, D) seq-sharded."""
+    mesh = groups.get_mesh()
+    d = q.shape[-1]
+    scale = scale if scale is not None else d ** -0.5
+    batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1) or None
+    spec = P(batch_axes, axis_name, None, None)
+
+    vary_axes = (axis_name,) + (batch_axes or ())
+    fn = jax.shard_map(
+        functools.partial(_ring_body, axis_name=axis_name, scale=scale,
+                          vary_axes=vary_axes),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis_name} | (set(batch_axes) if batch_axes else set()),
+        check_vma=True)
+    return fn(q, k, v)
