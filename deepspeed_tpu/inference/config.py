@@ -1,0 +1,53 @@
+"""Inference configuration.
+
+Analog of ``deepspeed/inference/config.py`` (DeepSpeedInferenceConfig).
+Field names kept so reference-style ``init_inference(..., dtype=...,
+tensor_parallel={"tp_size": N})`` calls parse unchanged.
+"""
+
+from typing import Any, Dict, Optional, Union
+
+from pydantic import Field
+
+from ..runtime.config_utils import DeepSpeedConfigModel
+
+
+class DeepSpeedTPConfig(DeepSpeedConfigModel):
+    enabled: bool = True
+    tp_size: int = 1
+    mpu: Optional[Any] = None
+    tp_group: Optional[Any] = None
+
+
+class QuantizationConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    qkv: Optional[Any] = None
+    bits: int = 8
+    group_size: int = 64
+
+
+class DeepSpeedInferenceConfig(DeepSpeedConfigModel):
+    replace_with_kernel_inject: bool = False
+    dtype: str = "bfloat16"
+    tensor_parallel: DeepSpeedTPConfig = DeepSpeedTPConfig()
+    enable_cuda_graph: bool = False      # parity knob; XLA always compiles
+    zero: Dict[str, Any] = {}
+    triangular_masking: bool = True
+    moe: Union[bool, Dict[str, Any]] = False
+    quant: QuantizationConfig = QuantizationConfig()
+    checkpoint: Optional[Union[str, Dict]] = None
+    base_dir: str = ""
+    max_tokens: int = Field(4096, alias="max_out_tokens")
+    min_out_tokens: int = Field(1, alias="min_out_tokens")
+    transposed_mode: bool = False
+    mp_size: int = 1                     # legacy alias for tp_size
+    replace_method: str = "auto"
+    injection_policy: Optional[Dict] = None
+    injection_policy_tuple: Optional[tuple] = None
+    config: Optional[Dict] = None
+    save_mp_checkpoint_path: Optional[str] = None
+    checkpoint_config: Dict[str, Any] = Field({}, alias="ds_config")
+
+    @property
+    def tp_size_effective(self):
+        return max(self.tensor_parallel.tp_size, self.mp_size)

@@ -1,0 +1,131 @@
+"""Inference engine (v1 analog).
+
+Analog of ``deepspeed/inference/engine.py:41`` (InferenceEngine). The
+reference injects fused CUDA kernels into a torch module and slices weights
+for TP (``_apply_injection_policy:411``). Here "injection" is conversion to
+the native CausalLM (``module_inject``) whose params carry TP shardings over
+the ``tensor`` mesh axis; the decode step is one compiled scan (the
+CUDA-graph capture/replay knobs become XLA compilation, which is always on).
+"""
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm as dist
+from ..models.transformer import CausalLM
+from ..parallel import sharding as shd
+from ..utils import groups
+from ..utils.logging import log_dist, logger
+from .config import DeepSpeedInferenceConfig
+from .sampling import sample_logits
+
+
+class InferenceEngine:
+    def __init__(self, model, config: Optional[DeepSpeedInferenceConfig] = None,
+                 params=None):
+        self._config = config or DeepSpeedInferenceConfig()
+        if not dist.is_initialized():
+            dist.init_distributed(verbose=False)
+        self.mesh = groups.get_mesh()
+
+        from ..module_inject import as_inference_model
+        self.model, converted_params = as_inference_model(model, self._config)
+        if params is not None:
+            converted_params = params
+
+        dt = self._config.dtype.replace("torch.", "").replace("half", "float16")
+        if self.model.cfg.dtype != dt and dt in ("float16", "bfloat16", "float32"):
+            self.model.cfg = self.model.cfg.replace(dtype=dt)
+
+        # TP/ZeRO-inference shardings from the same logical-axis rules as training
+        abstract = self.model.abstract_params()
+        logical = self.model.logical_axes()
+        self.param_shardings = shd.tree_shardings(abstract, logical, shd.BASE_RULES, self.mesh)
+
+        if converted_params is None:
+            with self.mesh:
+                self.module_params = jax.jit(self.model.init,
+                                             out_shardings=self.param_shardings)(
+                    jax.random.PRNGKey(0))
+        else:
+            self.module_params = jax.device_put(converted_params, self.param_shardings)
+
+        self._decode_fn = None
+        self._cache = None
+        self._cache_max = 0
+        log_dist(f"InferenceEngine ready: params={self.model.param_count() / 1e6:.1f}M "
+                 f"tp={self.mesh.shape['tensor']}", ranks=[0])
+
+    # -- reference-parity surface --
+
+    def forward(self, input_ids, *args, **kwargs):
+        return jax.jit(self.model.apply)(self.module_params, jnp.asarray(input_ids))
+
+    __call__ = forward
+
+    def module_state_dict(self):
+        return jax.device_get(self.module_params)
+
+    def _get_decode_fn(self):
+        if self._decode_fn is None:
+            @jax.jit
+            def decode(params, ids, cache, cache_len):
+                return self.model.apply_decode(params, ids, cache, cache_len)
+            self._decode_fn = decode
+        return self._decode_fn
+
+    def generate(self, input_ids, max_new_tokens: int = 32, *, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                 seed: int = 0, return_dict: bool = False, **kwargs):
+        """Batch generation with a compiled prefill + compiled decode loop.
+
+        input_ids: (B, S_prompt) — right-aligned prompts (no padding support
+        in v1; use the ragged v2 engine for mixed lengths).
+        """
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        b, s_prompt = ids.shape
+        max_len = s_prompt + max_new_tokens
+        cache = self.model.init_cache(b, max_len)
+        decode = self._get_decode_fn()
+
+        # prefill
+        cache_len = jnp.zeros((b,), jnp.int32)
+        logits, cache = decode(self.module_params, ids, cache, cache_len)
+        cache_len = cache_len + s_prompt
+        rng = jax.random.PRNGKey(seed)
+        rng, sub = jax.random.split(rng)
+        next_tok = sample_logits(logits[:, -1].astype(jnp.float32), sub,
+                                 temperature=temperature, top_k=top_k, top_p=top_p,
+                                 greedy=temperature == 0.0)
+
+        @jax.jit
+        def step(carry, _):
+            cache, cache_len, tok, rng, done = carry
+            logits, cache = self.model.apply_decode(self.module_params, tok[:, None],
+                                                    cache, cache_len)
+            rng, sub = jax.random.split(rng)
+            nxt = sample_logits(logits[:, -1].astype(jnp.float32), sub,
+                                temperature=temperature, top_k=top_k, top_p=top_p,
+                                greedy=temperature == 0.0)
+            if eos_token_id is not None:
+                nxt = jnp.where(done, eos_token_id, nxt)
+                done = done | (nxt == eos_token_id)
+            return (cache, cache_len + 1, nxt, rng, done), tok
+
+        done0 = jnp.zeros((b,), bool)
+        (_, _, last, _, _), toks = jax.lax.scan(
+            step, (cache, cache_len, next_tok, rng, done0), None, length=max_new_tokens - 1)
+        out_new = jnp.concatenate([toks.T, last[:, None]], axis=1)  # (B, max_new)
+        full = jnp.concatenate([ids, out_new], axis=1)
+        if return_dict:
+            return {"sequences": full, "new_tokens": out_new}
+        return full
+
+    @property
+    def config(self):
+        return self._config

@@ -1,0 +1,37 @@
+"""KV block allocator.
+
+Analog of ``inference/v2/ragged/blocked_allocator.py`` (BlockedAllocator):
+free-list over a fixed pool of KV-cache blocks. Host-side bookkeeping — the
+device only ever sees block-id tensors.
+"""
+
+from typing import List
+
+
+class BlockedAllocator:
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks))
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> List[int]:
+        if num_blocks > len(self._free):
+            raise RuntimeError(f"Out of KV blocks: requested {num_blocks}, "
+                               f"free {len(self._free)}/{self._num_blocks}")
+        taken, self._free = self._free[:num_blocks], self._free[num_blocks:]
+        return taken
+
+    def free(self, blocks: List[int]) -> None:
+        dupes = set(blocks) & set(self._free)
+        if dupes:
+            raise RuntimeError(f"double-free of KV blocks {sorted(dupes)}")
+        self._free.extend(blocks)

@@ -1,0 +1,61 @@
+"""Blocked (paged) KV cache.
+
+Analog of ``inference/v2/ragged/kv_cache.py:40`` (BlockedKVCache): KV lives
+in fixed-size blocks in a device pool; sequences hold block lists, so memory
+scales with tokens actually generated instead of max_seq_len per slot.
+
+Layout: k/v pools are (L, num_blocks, block_size, KVH, D). A sequence's
+logical cache is the concatenation of its blocks; attention gathers pages by
+block table (XLA gather; a Pallas in-place paged-attention kernel is the
+optimization path).
+"""
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .blocked_allocator import BlockedAllocator
+
+
+class BlockedKVCache:
+    def __init__(self, num_layers: int, kv_heads: int, head_dim: int,
+                 num_blocks: int, block_size: int = 64, dtype=jnp.bfloat16):
+        self.num_layers = num_layers
+        self.kv_heads = kv_heads
+        self.head_dim = head_dim
+        self.block_size = block_size
+        self.num_blocks = num_blocks
+        shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+        self.k = jnp.zeros(shape, dtype)
+        self.v = jnp.zeros(shape, dtype)
+        self.allocator = BlockedAllocator(num_blocks)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return (num_tokens + self.block_size - 1) // self.block_size
+
+    @property
+    def free_blocks(self) -> int:
+        return self.allocator.free_blocks
+
+    def write(self, block_ids: jnp.ndarray, start_pos: int, new_k, new_v):
+        """Scatter S new tokens into the paged pools.
+
+        block_ids: (max_blocks,) int32 block table of the sequence;
+        start_pos: int, first logical slot to write; new_k/new_v: (L, S, KVH, D).
+        """
+        s = new_k.shape[1]
+        pos = start_pos + jnp.arange(s)
+        blk = block_ids[pos // self.block_size]       # (S,) physical block
+        off = pos % self.block_size                    # (S,) offset in block
+        self.k = self.k.at[:, blk, off].set(new_k)
+        self.v = self.v.at[:, blk, off].set(new_v)
+
+    def gather(self, block_table: jnp.ndarray):
+        """block_table: (B, max_blocks) → (L, B, max_blocks*block_size, KVH, D)
+        contiguous logical view (padding blocks read block 0 — callers mask
+        by sequence length)."""
+        k = jnp.take(self.k, block_table, axis=1)      # (L, B, max_blocks, bs, KVH, D)
+        v = jnp.take(self.v, block_table, axis=1)
+        l, b, nb, bs, kvh, d = k.shape
+        return (k.reshape(l, b, nb * bs, kvh, d), v.reshape(l, b, nb * bs, kvh, d))

@@ -1,0 +1,128 @@
+"""Paged forward execution for ragged inference.
+
+Analog of the FastGen model pass (``inference/v2/model_implementations/
+inference_transformer_base.py`` + ``kernels/ragged_ops/linear_blocked_kv_rotary``
++ ``blocked_flash``): one compiled function handles a batch of sequence
+chunks — prefill chunks (C>1) and decode steps (C=1) are the same program at
+different chunk widths, which is the Dynamic-SplitFuse unification.
+
+Per layer, inside a ``lax.scan`` over the stacked params zipped with the KV
+pools' layer slices: project q/k/v, RoPE at absolute positions, scatter the
+chunk's KV into its pages, gather the sequence's pages, attend with per-query
+causal masking. Pools are donated, so XLA updates pages in place.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ...models import layers as L
+from ...models.transformer import CausalLM
+from ...ops.attention import decode_attention
+
+
+class PagedModelRunner:
+    def __init__(self, model: CausalLM, block_size: int, max_blocks_per_seq: int):
+        self.model = model
+        self.cfg = model.cfg
+        self.block_size = block_size
+        self.max_blocks = max_blocks_per_seq
+        self._fns = {}
+
+    def _build(self, chunk: int):
+        cfg = self.cfg
+        bs = self.block_size
+        model = self.model
+
+        @functools.partial(jax.jit, donate_argnums=(5, 6))
+        def run(params, ids, positions, block_tables, valid_counts, kpool, vpool):
+            """ids/positions: (B, C); block_tables: (B, MB);
+            valid_counts: (B,) number of real (non-pad) tokens in the chunk;
+            kpool/vpool: (L, NB, bs, KVH, D). Returns (last_logits (B, V),
+            kpool, vpool)."""
+            dt = cfg.act_dtype
+            b, c = ids.shape
+            h = params["embed"]["tok"].astype(dt)[ids]
+            if cfg.position == "learned":
+                h = h + params["embed"]["pos"].astype(dt)[jnp.clip(positions, 0,
+                                                                   cfg.max_seq_len - 1)]
+            inv_freq = model._inv_freq
+            b_idx = jnp.arange(b)[:, None]                      # (B, 1)
+            # positions < 0 mark padding: route their writes to trash block 0
+            is_pad = positions < 0
+            pos_safe = jnp.maximum(positions, 0)
+            blk = jnp.where(is_pad, 0, jnp.take_along_axis(
+                block_tables, pos_safe // bs, axis=1))          # (B, C)
+            off = pos_safe % bs
+            seq_lens_after = jnp.max(jnp.where(is_pad, 0, positions + 1), axis=1)
+
+            def layer(h, xs):
+                lp, kp, vp = xs
+                a_in = L.apply_norm(lp["norm1"], h, cfg)
+                q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
+                k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
+                v = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wv"].astype(dt))
+                if cfg.use_bias:
+                    q = q + lp["attn"]["bq"].astype(dt)
+                    k = k + lp["attn"]["bk"].astype(dt)
+                    v = v + lp["attn"]["bv"].astype(dt)
+                if cfg.position == "rope":
+                    q = L.apply_rope(q, pos_safe, inv_freq)
+                    k = L.apply_rope(k, pos_safe, inv_freq)
+                kp = kp.at[blk, off].set(k.astype(kp.dtype))
+                vp = vp.at[blk, off].set(v.astype(vp.dtype))
+                kpages = kp[block_tables].reshape(b, -1, cfg.kv_heads, cfg.dims_per_head)
+                vpages = vp[block_tables].reshape(b, -1, cfg.kv_heads, cfg.dims_per_head)
+                # per-query causal mask via positions: query at position p sees
+                # cache slots [0, p]; decode_attention masks by slot index.
+                out = _paged_attention(q, kpages, vpages, positions, cfg)
+                y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
+                if cfg.use_bias:
+                    y = y + lp["attn"]["bo"].astype(dt)
+                h2 = h + y
+                m_in = L.apply_norm(lp["norm2"], h2, cfg)
+                if cfg.is_moe:
+                    mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
+                else:
+                    mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+                return h2 + mlp_out, (kp, vp)
+
+            h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool))
+            h = L.apply_norm(params["final_norm"], h, cfg)
+            # last valid token of each chunk
+            last_idx = jnp.maximum(valid_counts - 1, 0)
+            h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+            if cfg.tie_embeddings:
+                logits = jnp.einsum("be,ve->bv", h_last, params["embed"]["tok"].astype(dt))
+            else:
+                logits = jnp.einsum("be,ev->bv", h_last, params["embed"]["lm_head"].astype(dt))
+            return logits.astype(jnp.float32), kpool, vpool
+
+        return run
+
+    def run(self, chunk: int, *args):
+        if chunk not in self._fns:
+            self._fns[chunk] = self._build(chunk)
+        return self._fns[chunk](*args)
+
+
+def _paged_attention(q, kpages, vpages, positions, cfg):
+    """q: (B, C, H, D); kpages/vpages: (B, S_pad, KVH, D); positions: (B, C)
+    absolute slot of each query (−1 = pad). Query at slot p attends slots ≤ p."""
+    h = q.shape[2]
+    kvh = kpages.shape[2]
+    if kvh != h:
+        rep = h // kvh
+        kpages = jnp.repeat(kpages, rep, axis=2)
+        vpages = jnp.repeat(vpages, rep, axis=2)
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, kpages,
+                        preferred_element_type=jnp.float32) * (d ** -0.5)
+    k_pos = jnp.arange(kpages.shape[1])[None, None, :]
+    mask = k_pos <= positions[:, :, None]               # (B, C, S_pad); pad rows all-False
+    logits = jnp.where(mask[:, None], logits, jnp.finfo(jnp.float32).min)
+    # pad queries have no visible keys: softmax over -inf row → uniform; their
+    # outputs are discarded by the caller, and max-subtraction keeps it finite.
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, vpages)

@@ -1,0 +1,71 @@
+"""Sequence state tracking for continuous batching.
+
+Analog of ``inference/v2/ragged/ragged_manager.py:19`` (DSStateManager) and
+``sequence_descriptor.py`` (DSSequenceDescriptor).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class DSSequenceDescriptor:
+    uid: int
+    blocks: List[int] = dataclasses.field(default_factory=list)
+    seen_tokens: int = 0            # tokens whose KV is in cache
+    pending: List[int] = dataclasses.field(default_factory=list)   # not yet prefetched
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+    slot: int = -1                  # decode-slot index, -1 = not resident
+
+    @property
+    def in_prefill(self) -> bool:
+        return len(self.pending) > 0
+
+    @property
+    def cur_len(self) -> int:
+        return self.seen_tokens
+
+
+class DSStateManager:
+    """Owns sequence descriptors + their KV block lists."""
+
+    def __init__(self, kv_cache, max_tracked_sequences: int = 2048):
+        self.kv_cache = kv_cache
+        self.max_tracked = max_tracked_sequences
+        self.seqs: Dict[int, DSSequenceDescriptor] = {}
+
+    def get_or_create_sequence(self, uid: int) -> DSSequenceDescriptor:
+        if uid in self.seqs:
+            return self.seqs[uid]
+        if len(self.seqs) >= self.max_tracked:
+            raise RuntimeError(f"tracking limit reached ({self.max_tracked} sequences)")
+        seq = DSSequenceDescriptor(uid=uid)
+        self.seqs[uid] = seq
+        return seq
+
+    def ensure_capacity(self, seq: DSSequenceDescriptor, new_total_tokens: int) -> bool:
+        """Grow the sequence's block list to hold ``new_total_tokens``;
+        returns False if the pool can't satisfy it."""
+        need = self.kv_cache.blocks_for(new_total_tokens) - len(seq.blocks)
+        if need <= 0:
+            return True
+        if need > self.kv_cache.allocator.free_blocks:
+            return False
+        seq.blocks.extend(self.kv_cache.allocator.allocate(need))
+        return True
+
+    def flush_sequence(self, uid: int):
+        seq = self.seqs.pop(uid, None)
+        if seq is not None and seq.blocks:
+            self.kv_cache.allocator.free(seq.blocks)
+
+    def block_table(self, seq: DSSequenceDescriptor, max_blocks: int) -> jnp.ndarray:
+        tbl = seq.blocks + [0] * (max_blocks - len(seq.blocks))
+        return jnp.asarray(tbl[:max_blocks], jnp.int32)
+
+    @property
+    def tracked_sequences(self):
+        return dict(self.seqs)

@@ -148,17 +148,17 @@ class CausalLM:
     # -- decode (KV-cache) --
 
     def init_cache(self, batch_size, max_len, dtype=None):
+        """Stacked KV cache: {"k","v"}: (L, B, S_max, KVH, D) — scan-able."""
         cfg = self.cfg
         dt = dtype or cfg.act_dtype
-        shape = (batch_size, max_len, cfg.kv_heads, cfg.dims_per_head)
-        zeros = jnp.zeros(shape, dt)
-        return [(zeros, zeros) for _ in range(cfg.num_layers)]
+        shape = (cfg.num_layers, batch_size, max_len, cfg.kv_heads, cfg.dims_per_head)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
     def apply_decode(self, params, input_ids, cache, cache_len):
         """Incremental forward: input_ids (B, S_new); returns (logits, cache).
 
-        Decode runs layer-by-layer (unstacked) since each layer mutates its
-        own cache entry; cache is a list of (k, v) per layer.
+        ``lax.scan`` zips the stacked layer params with the stacked cache —
+        one compiled layer regardless of depth, updated cache as scan ys.
         """
         cfg = self.cfg
         dt = cfg.act_dtype
@@ -167,27 +167,28 @@ class CausalLM:
         h = params["embed"]["tok"].astype(dt)[input_ids]
         if cfg.position == "learned":
             h = h + params["embed"]["pos"].astype(dt)[positions]
-        new_cache = []
-        for i in range(cfg.num_layers):
-            lp = jax.tree.map(lambda x: x[i], params["layers"])
+
+        def body(h, layer_in):
+            lp, ck, cv = layer_in
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                              inv_freq=self._inv_freq,
-                                             kv_cache=cache[i], cache_len=cache_len)
-            new_cache.append(kv)
+                                             kv_cache=(ck, cv), cache_len=cache_len)
             h = h + attn_out
             m_in = L.apply_norm(lp["norm2"], h, cfg)
             if cfg.is_moe:
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
-            h = h + mlp_out
+            return h + mlp_out, kv
+
+        h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
         h = L.apply_norm(params["final_norm"], h, cfg)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
         else:
             logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
-        return logits, new_cache
+        return logits, {"k": new_k, "v": new_v}
 
     # -- loss --
 

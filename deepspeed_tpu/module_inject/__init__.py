@@ -1,0 +1,19 @@
+"""Module injection: user-model → native-model conversion (AutoTP analog)."""
+
+from .replace_module import (hf_config_to_native, hf_to_native,  # noqa: F401
+                             replace_transformer_layer)
+
+
+def as_inference_model(model, config=None):
+    """Normalize init_inference input → (CausalLM, params-or-None)."""
+    from ..models.config import TransformerConfig
+    from ..models.transformer import CausalLM, build_model
+
+    if isinstance(model, CausalLM):
+        return model, None
+    if isinstance(model, (str, TransformerConfig)):
+        return build_model(model), None
+    # duck-type HF transformers torch modules
+    if hasattr(model, "state_dict") and hasattr(model, "config"):
+        return hf_to_native(model)
+    raise TypeError(f"init_inference: unsupported model type {type(model)}")

@@ -104,11 +104,13 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
-    """Single-token decode attention against a (B, S_max, KVH, D) KV cache.
+    """Decode/prefill attention against a (B, S_max, KVH, D) KV cache.
 
-    q: (B, 1, H, D). ``cache_len``: (B,) int32 number of valid cache slots.
+    q: (B, S_new, H, D) — the S_new query tokens occupy cache slots
+    [cache_len - S_new, cache_len); each query attends causally: key slot k
+    is visible to query i iff k < cache_len - S_new + i + 1.
     """
-    b, _, h, d = q.shape
+    b, s_new, h, d = q.shape
     kvh = k_cache.shape[2]
     if kvh != h:
         rep = h // kvh
@@ -116,7 +118,9 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
         v_cache = jnp.repeat(v_cache, rep, axis=2)
     scale = scale if scale is not None else d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32) * scale
-    mask = jnp.arange(k_cache.shape[1])[None, :] < cache_len[:, None]  # (B, S_max)
-    logits = jnp.where(mask[:, None, None, :], logits, jnp.finfo(jnp.float32).min)
+    q_pos = (cache_len[:, None] - s_new) + jnp.arange(s_new)[None, :]      # (B, S_new)
+    k_pos = jnp.arange(k_cache.shape[1])[None, None, :]                    # (1, 1, S_max)
+    mask = k_pos <= q_pos[:, :, None]                                      # (B, S_new, S_max)
+    logits = jnp.where(mask[:, None, :, :], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)
