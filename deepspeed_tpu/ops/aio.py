@@ -1,0 +1,85 @@
+"""Python surface of the async I/O engine.
+
+Analog of the reference's ``deepspeed.ops.op_builder.AsyncIOBuilder`` module
+(``csrc/aio/py_lib/deepspeed_py_aio_handle.cpp`` handle API): submit async
+reads/writes of numpy buffers against files, wait for completion.
+"""
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from .op_builder import AsyncIOBuilder
+
+_lib = None
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        _lib = AsyncIOBuilder().load(verbose=False)
+        _lib.ds_aio_handle_new.restype = ctypes.c_void_p
+        _lib.ds_aio_handle_new.argtypes = [ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        _lib.ds_aio_handle_free.argtypes = [ctypes.c_void_p]
+        for fn in ("ds_aio_pread", "ds_aio_pwrite"):
+            getattr(_lib, fn).restype = ctypes.c_int64
+            getattr(_lib, fn).argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                          ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64]
+        _lib.ds_aio_wait.argtypes = [ctypes.c_void_p]
+        _lib.ds_aio_error_count.restype = ctypes.c_int64
+        _lib.ds_aio_error_count.argtypes = [ctypes.c_void_p]
+        _lib.ds_aio_inflight.restype = ctypes.c_int64
+        _lib.ds_aio_inflight.argtypes = [ctypes.c_void_p]
+    return _lib
+
+
+class AsyncIOHandle:
+    """Thread-pooled positional I/O handle (reference aio_handle)."""
+
+    def __init__(self, queue_depth: int = 8, block_size: int = 1 << 20,
+                 use_direct: bool = False):
+        self._lib = _get_lib()
+        self._h = self._lib.ds_aio_handle_new(queue_depth, block_size, int(use_direct))
+        self._pinned = []  # keep buffers alive while requests are in flight
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.ds_aio_wait(self._h)
+                self._lib.ds_aio_handle_free(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    def _buf_ptr(self, arr: np.ndarray):
+        assert arr.flags["C_CONTIGUOUS"], "aio buffers must be C-contiguous"
+        self._pinned.append(arr)
+        return arr.ctypes.data_as(ctypes.c_void_p)
+
+    def async_pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        return self._lib.ds_aio_pwrite(self._h, path.encode(), self._buf_ptr(arr),
+                                       arr.nbytes, offset)
+
+    def async_pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        return self._lib.ds_aio_pread(self._h, path.encode(), self._buf_ptr(arr),
+                                      arr.nbytes, offset)
+
+    def wait(self) -> int:
+        self._lib.ds_aio_wait(self._h)
+        errs = int(self._lib.ds_aio_error_count(self._h))
+        self._pinned.clear()
+        return errs
+
+    def sync_pwrite(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pwrite(arr, path, offset)
+        return self.wait()
+
+    def sync_pread(self, arr: np.ndarray, path: str, offset: int = 0) -> int:
+        self.async_pread(arr, path, offset)
+        return self.wait()
+
+    @property
+    def inflight(self) -> int:
+        return int(self._lib.ds_aio_inflight(self._h))

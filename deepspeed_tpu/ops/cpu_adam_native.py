@@ -1,0 +1,56 @@
+"""ctypes surface of the native host Adam/Adagrad/Lion kernels.
+
+Analog of the reference's DeepSpeedCPUAdam binding (``csrc/adam/cpu_adam.cpp``
+→ ``deepspeed.ops.adam.DeepSpeedCPUAdam``): flat fp32 buffers updated in
+place on the host while the accelerator runs ahead.
+"""
+
+import ctypes
+
+import numpy as np
+
+from .op_builder import CPUAdamBuilder
+
+_lib = None
+
+
+def _get_lib():
+    global _lib
+    if _lib is None:
+        _lib = CPUAdamBuilder().load(verbose=False)
+        f = ctypes.POINTER(ctypes.c_float)
+        _lib.ds_cpu_adam_step.argtypes = [f, f, f, f, ctypes.c_int64, ctypes.c_int64,
+                                          ctypes.c_float, ctypes.c_float, ctypes.c_float,
+                                          ctypes.c_float, ctypes.c_float,
+                                          ctypes.c_int, ctypes.c_int]
+        _lib.ds_cpu_adagrad_step.argtypes = [f, f, f, ctypes.c_int64, ctypes.c_float,
+                                             ctypes.c_float, ctypes.c_float]
+        _lib.ds_cpu_lion_step.argtypes = [f, f, f, ctypes.c_int64, ctypes.c_float,
+                                          ctypes.c_float, ctypes.c_float, ctypes.c_float]
+    return _lib
+
+
+def _fp(a: np.ndarray):
+    assert a.dtype == np.float32 and a.flags["C_CONTIGUOUS"]
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def cpu_adam_step(params: np.ndarray, grads: np.ndarray, exp_avg: np.ndarray,
+                  exp_avg_sq: np.ndarray, step: int, lr: float,
+                  betas=(0.9, 0.999), eps: float = 1e-8, weight_decay: float = 0.0,
+                  adamw_mode: bool = True, bias_correction: bool = True):
+    """In-place AdamW update on host fp32 buffers."""
+    lib = _get_lib()
+    lib.ds_cpu_adam_step(_fp(params), _fp(grads), _fp(exp_avg), _fp(exp_avg_sq),
+                         params.size, step, lr, betas[0], betas[1], eps, weight_decay,
+                         int(adamw_mode), int(bias_correction))
+
+
+def cpu_adagrad_step(params, grads, exp_avg_sq, lr, eps=1e-10, weight_decay=0.0):
+    _get_lib().ds_cpu_adagrad_step(_fp(params), _fp(grads), _fp(exp_avg_sq),
+                                   params.size, lr, eps, weight_decay)
+
+
+def cpu_lion_step(params, grads, exp_avg, lr, betas=(0.9, 0.99), weight_decay=0.0):
+    _get_lib().ds_cpu_lion_step(_fp(params), _fp(grads), _fp(exp_avg),
+                                params.size, lr, betas[0], betas[1], weight_decay)

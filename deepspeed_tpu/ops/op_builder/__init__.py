@@ -45,10 +45,10 @@ class CPUAdamBuilder(NativeOpBuilder):
         super().__init__("cpu_adam")
 
     def sources(self):
-        return ["csrc/cpu_adam.cpp"]
+        return ["deepspeed_tpu/ops/csrc/adam/cpu_adam.cpp"]
 
     def include_paths(self):
-        return ["csrc"]
+        return ["deepspeed_tpu/ops/csrc"]
 
     def cxx_args(self):
         import platform
@@ -65,10 +65,10 @@ class AsyncIOBuilder(NativeOpBuilder):
         super().__init__("async_io")
 
     def sources(self):
-        return ["csrc/aio.cpp"]
+        return ["deepspeed_tpu/ops/csrc/aio/deepspeed_aio.cpp"]
 
     def include_paths(self):
-        return ["csrc"]
+        return ["deepspeed_tpu/ops/csrc"]
 
     def extra_ldflags(self):
         return ["-lpthread"]

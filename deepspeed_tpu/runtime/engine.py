@@ -125,6 +125,17 @@ class DeepSpeedEngine:
                                      out_shardings=self.opt_state_shardings)(self.module_params)
 
         # ---- precision / loss scaling ----
+        # NVMe optimizer offload: state parked on disk between steps
+        self._opt_swapper = None
+        off = self._config.zero_config.offload_optimizer
+        if off is not None and off.device == "nvme":
+            from .swap_tensor.swapper import OptimizerSwapper
+            swap_dir = os.path.join(off.nvme_path or "/tmp/ds_tpu_nvme", "optimizer")
+            self._opt_swapper = OptimizerSwapper(swap_dir)
+            self._opt_swapper.swap_out_optimizer(jax.device_get(self.opt_state))
+            self.opt_state = None
+            log_dist(f"Optimizer state swapped to NVMe at {swap_dir}", ranks=[0])
+
         self.loss_scaler = create_loss_scaler(self._config.fp16, self._config.precision_dtype)
         self.scaler_state = self.loss_scaler.init_state()
         self.gradient_clipping = float(self._config.gradient_clipping or 0.0)
@@ -221,7 +232,32 @@ class DeepSpeedEngine:
         slot_shardings = treedef.unflatten([
             jax.tree.map(lambda _: sh, slot) for sh, slot in zip(flat_shard, flat_slots)
         ])
-        return {"step": self._replicated, "slots": slot_shardings}
+        shardings = {"step": self._replicated, "slots": slot_shardings}
+        # ZeRO-Offload: optimizer state lives in host memory; the update
+        # stages it through device memory (reference: CPUAdam on pinned
+        # buffers, stage_1_and_2.py:1189 grad offload path).
+        self._opt_device_shardings = shardings
+        off = self._config.zero_config.offload_optimizer
+        if off is not None and off.device == "cpu" and self._host_memory_kind():
+            kind = self._host_memory_kind()
+            shardings = jax.tree.map(lambda s: s.with_memory_kind(kind), shardings,
+                                     is_leaf=lambda x: isinstance(x, NamedSharding))
+        return shardings
+
+    def _host_memory_kind(self):
+        # Only meaningful on a real accelerator: on the CPU backend all
+        # memory IS host memory (and its SPMD partitioner rejects the
+        # placement annotation anyway).
+        if jax.default_backend() != "tpu":
+            return None
+        try:
+            kinds = {m.kind for m in self.mesh.devices.flat[0].addressable_memories()}
+        except Exception:
+            return None
+        for kind in ("pinned_host", "unpinned_host"):
+            if kind in kinds:
+                return kind
+        return None
 
     # ------------------------------------------------------------------
     # compiled step functions
@@ -239,6 +275,9 @@ class DeepSpeedEngine:
 
     def _apply_update(self, params, opt_state, scaler_state, grads, lr, grad_divisor):
         """Unscale, clip, overflow-check, optimizer apply (or skip)."""
+        host_offload = self.opt_state_shardings is not self._opt_device_shardings
+        if host_offload:  # stage host-resident state into device memory
+            opt_state = jax.device_put(opt_state, self._opt_device_shardings)
         inv = 1.0 / (scaler_state.scale * grad_divisor)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
         overflow = has_overflow(grads)
@@ -251,6 +290,8 @@ class DeepSpeedEngine:
         new_params = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new_params, params)
         new_opt = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state)
         new_scaler = self.loss_scaler.update(scaler_state, overflow)
+        if host_offload:  # results stream back to pinned host buffers
+            new_opt = jax.device_put(new_opt, self.opt_state_shardings)
         return new_params, new_opt, new_scaler, overflow, grad_norm
 
     def _compile_step_fns(self):
@@ -411,9 +452,11 @@ class DeepSpeedEngine:
         assert self._acc_grads is not None, "step() without accumulated gradients"
         self.timers(STEP_GLOBAL_TIMER).start()
         lr = jnp.float32(self._next_lr())
+        self._swap_in_opt_state()
         (self.module_params, self.opt_state, self.scaler_state, overflow,
          grad_norm) = self._update_fn(self.module_params, self.opt_state, self.scaler_state,
                                       self._acc_grads, lr, jnp.float32(self._acc_count))
+        self._swap_out_opt_state()
         self._acc_grads = None
         self._acc_count = 0
         self.global_steps += 1
@@ -446,9 +489,11 @@ class DeepSpeedEngine:
         batch = jax.tree.map(reshape, batch)
         self.tput_timer.start()
         lr = jnp.float32(self._next_lr())
+        self._swap_in_opt_state()
         (self.module_params, self.opt_state, self.scaler_state, loss, overflow,
          grad_norm) = self._train_step_fn(self.module_params, self.opt_state,
                                           self.scaler_state, batch, lr, gas=gas)
+        self._swap_out_opt_state()
         self.micro_steps += gas
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
@@ -460,6 +505,16 @@ class DeepSpeedEngine:
         batch = self._put_batch(batch)
         loss = jax.jit(self.model.loss)(self.module_params, batch)
         return loss
+
+    def _swap_in_opt_state(self):
+        if self._opt_swapper is not None and self.opt_state is None:
+            host_state = self._opt_swapper.swap_in_optimizer()
+            self.opt_state = jax.device_put(host_state, self.opt_state_shardings)
+
+    def _swap_out_opt_state(self):
+        if self._opt_swapper is not None and self.opt_state is not None:
+            self._opt_swapper.swap_out_optimizer(jax.device_get(self.opt_state))
+            self.opt_state = None
 
     def _next_lr(self):
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
@@ -494,6 +549,7 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         tag = tag or f"global_step{self.global_steps}"
+        self._swap_in_opt_state()
         state = {
             "module": self.module_params,
             "optimizer": self.opt_state,
