@@ -1,0 +1,36 @@
+"""Grouped (expert) matmul for MoE.
+
+Analog of ``inference/v2/kernels/cutlass_ops/moe_gemm`` (grouped GEMM over
+per-expert token groups). On TPU the idiomatic primitive is
+``jax.lax.ragged_dot`` (Megablox-style: rows grouped by expert, group sizes
+ragged) which XLA lowers to MXU-tiled grouped matmul; a dense einsum fallback
+covers platforms/shapes where ragged_dot is unavailable.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def grouped_gemm(tokens, expert_weights, group_sizes):
+    """tokens: (T, E) rows sorted by expert; expert_weights: (X, E, F);
+    group_sizes: (X,) rows per expert. Returns (T, F)."""
+    if hasattr(jax.lax, "ragged_dot"):
+        try:
+            return jax.lax.ragged_dot(tokens, expert_weights, group_sizes)
+        except Exception:
+            pass
+    # fallback: dense one-hot dispatch (O(T·X·E·F) worst case, fused by XLA)
+    t = tokens.shape[0]
+    x = expert_weights.shape[0]
+    bounds = jnp.cumsum(group_sizes)
+    expert_of_row = jnp.sum(jnp.arange(t)[:, None] >= bounds[None, :], axis=1)  # (T,)
+    w_per_row = expert_weights[expert_of_row]        # (T, E, F) gather
+    return jnp.einsum("te,tef->tf", tokens, w_per_row)
+
+
+def moe_expert_ffn(tokens, wi_gate, wi_up, wo, group_sizes):
+    """SwiGLU expert FFN over grouped rows: (T, E) → (T, E)."""
+    g = grouped_gemm(tokens, wi_gate, group_sizes)
+    u = grouped_gemm(tokens, wi_up, group_sizes)
+    h = jax.nn.silu(g) * u
+    return grouped_gemm(h, wo, group_sizes)

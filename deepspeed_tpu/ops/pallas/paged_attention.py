@@ -1,0 +1,10 @@
+"""Paged decode attention.
+
+Analog of ``inference/v2/kernels/ragged_ops/blocked_flash`` (flash attention
+over paged KV atoms). Current implementation is the XLA gather path used by
+``inference/v2/model_runner.py`` (gather pages → masked attention); the
+Pallas kernel slot exists so the op-builder table and future in-place page
+reads share this import point.
+"""
+
+from ...inference.v2.model_runner import _paged_attention as paged_attention  # noqa: F401

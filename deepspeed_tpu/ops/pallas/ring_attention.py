@@ -1,0 +1,5 @@
+"""Ring attention op alias — implementation lives with the sequence-parallel
+layer (``deepspeed_tpu/sequence/ring_attention.py``); re-exported here so the
+op-builder registry resolves it like the other kernels."""
+
+from ...sequence.ring_attention import ring_attention  # noqa: F401

@@ -1,0 +1,118 @@
+"""Quantization kernel + quantized collective tests (reference pattern:
+tests/unit/ops/quantizer, tests/unit/runtime/comm)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.pallas.quantizer import (dequantize_int4, dequantize_int8,
+                                                quantize_int4, quantize_int8)
+from deepspeed_tpu.ops.pallas.fused_adam import fused_adam_flat
+from deepspeed_tpu.ops.pallas.grouped_gemm import grouped_gemm
+from deepspeed_tpu.runtime.comm.coalesced_collectives import (
+    quantized_all_gather, quantized_reduce_scatter, reduce_scatter_coalesced)
+from deepspeed_tpu.utils import groups
+
+
+def test_int8_roundtrip(rng):
+    x = jax.random.normal(rng, (64, 256))
+    q, s = quantize_int8(x, group_size=256)
+    back = dequantize_int8(q, s, group_size=256)
+    err = jnp.max(jnp.abs(back - x))
+    # max error bounded by scale/2 per group
+    assert float(err) <= float(jnp.max(s)) * 0.51, (float(err), float(jnp.max(s)))
+
+
+def test_int4_roundtrip(rng):
+    x = jax.random.normal(rng, (16, 256))
+    packed, s, shape = quantize_int4(x, group_size=256)
+    assert packed.shape[-1] == 128  # two nibbles per byte
+    back = dequantize_int4(packed, s, shape, group_size=256)
+    err = jnp.max(jnp.abs(back - x))
+    assert float(err) <= float(jnp.max(s)) * 0.51
+
+
+def test_quantized_reduce_scatter_close_to_exact(mesh_8dp, rng):
+    mesh = groups.get_mesh()
+    x = jax.random.normal(rng, (8, 2048))
+
+    def body(x):
+        return quantized_reduce_scatter(x[0], "data")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                       axis_names={"data"}, check_vma=True)
+    got = np.asarray(fn(x)).reshape(-1)
+    exact = np.asarray(jnp.sum(x, axis=0))
+    # int8 quantization error accumulates over 8 ranks; tolerance ~ 8 * scale/2
+    scale_bound = float(jnp.max(jnp.abs(x))) / 127
+    np.testing.assert_allclose(got, exact, atol=8 * scale_bound * 0.6)
+
+
+def test_quantized_all_gather(mesh_8dp, rng):
+    mesh = groups.get_mesh()
+    x = jax.random.normal(rng, (8, 256))
+
+    def body(shard):
+        # leading axis collects each rank's gathered copy
+        return quantized_all_gather(shard[0], "data").reshape(1, 8, 256)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+                       axis_names={"data"}, check_vma=True)
+    got = np.asarray(fn(x))                     # (8 ranks, 8, 256)
+    scale_bound = float(jnp.max(jnp.abs(x))) / 127
+    for r in range(8):
+        np.testing.assert_allclose(got[r], np.asarray(x), atol=scale_bound * 0.6)
+
+
+def test_reduce_scatter_coalesced(mesh_8dp, rng):
+    mesh = groups.get_mesh()
+    a = jax.random.normal(rng, (8, 64))
+    b = jax.random.normal(jax.random.fold_in(rng, 1), (8, 32))
+
+    def body(a, b):
+        reduced, sizes = reduce_scatter_coalesced([a[0], b[0]], "data")
+        return reduced
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(P("data"), P("data")),
+                       out_specs=P("data"), axis_names={"data"}, check_vma=True)
+    got = np.asarray(fn(a, b)).reshape(-1)
+    exact = np.concatenate([np.asarray(jnp.sum(a, 0)), np.asarray(jnp.sum(b, 0))])
+    np.testing.assert_allclose(got[:96], exact, atol=1e-5)
+
+
+def test_fused_adam_flat_matches_optimizer(rng):
+    from deepspeed_tpu.ops.optimizers import FusedAdam
+    n = 1024
+    p = jax.random.normal(rng, (n,))
+    g = jax.random.normal(jax.random.fold_in(rng, 1), (n,))
+    m = jnp.zeros((n,))
+    v = jnp.zeros((n,))
+    new_p, new_m, new_v = fused_adam_flat(p, g, m, v, step=1, lr=1e-2, weight_decay=0.01)
+
+    opt = FusedAdam(lr=1e-2, weight_decay=0.01)
+    params = {"x": p}
+    state = opt.init(params)
+    ref, _ = opt.apply({"x": g}, state, params)
+    np.testing.assert_allclose(np.asarray(new_p), np.asarray(ref["x"]), atol=1e-6)
+
+
+def test_grouped_gemm_matches_dense(rng):
+    t, x, e, f = 32, 4, 16, 24
+    tokens = jax.random.normal(rng, (t, e))
+    w = jax.random.normal(jax.random.fold_in(rng, 1), (x, e, f))
+    sizes = jnp.asarray([8, 8, 8, 8])
+    out = grouped_gemm(tokens, w, sizes)
+    want = jnp.concatenate([tokens[i * 8:(i + 1) * 8] @ w[i] for i in range(4)])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=1e-4, rtol=1e-4)
+
+
+def test_fp8_roundtrip(rng):
+    from deepspeed_tpu.ops.pallas.fp_quantizer import dequantize_fp8, quantize_fp8
+    x = jax.random.normal(rng, (8, 256))
+    q, s = quantize_fp8(x, group_size=256, stochastic=False)
+    back = dequantize_fp8(q, s, group_size=256)
+    # e4m3 has ~2 decimal digits; relative error bounded by ~6%
+    rel = jnp.max(jnp.abs(back - x) / (jnp.abs(x) + 1e-3))
+    assert float(rel) < 0.13, float(rel)
