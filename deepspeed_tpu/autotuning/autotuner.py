@@ -1,0 +1,107 @@
+"""Autotuner: micro-batch / ZeRO-stage search.
+
+Analog of ``deepspeed/autotuning/autotuner.py:42`` (``tune:404``, model-info
+profiling ``:663``, micro-batch search ``:741``). The reference launches
+separate experiment jobs; here trials run in-process (one compiled step per
+candidate, timed on the live mesh) which is cheap under XLA's compile cache.
+Search strategy: profile model memory → enumerate feasible (zero_stage,
+micro_batch) pairs → measure tokens/sec → pick the fastest.
+"""
+
+import itertools
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+
+DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16, 32)
+
+
+class Autotuner:
+    def __init__(self, model, base_config: Dict[str, Any], seq_len: int = 512,
+                 micro_batch_candidates=DEFAULT_MICRO_BATCHES,
+                 zero_stage_candidates=(0, 1, 2, 3), steps_per_trial: int = 3):
+        self.model = model
+        self.base_config = dict(base_config)
+        self.seq_len = seq_len
+        self.mb_candidates = list(micro_batch_candidates)
+        self.stage_candidates = list(zero_stage_candidates)
+        self.steps_per_trial = steps_per_trial
+        self.results: List[Dict[str, Any]] = []
+
+    def model_info(self) -> Dict[str, Any]:
+        """Analog of the model-info profile run (:663)."""
+        n = self.model.param_count()
+        return {"num_params": n,
+                "fp32_mem_gb": 4 * n / 2 ** 30,
+                "adam_state_gb": 8 * n / 2 ** 30}
+
+    def _trial(self, zero_stage: int, micro_batch: int) -> Optional[float]:
+        import jax
+        import deepspeed_tpu as ds
+        from ..utils import groups
+        import deepspeed_tpu.comm.comm as dc
+        groups.reset_mesh()
+        dc.cdb = None
+        dp = max(1, len(jax.devices()))
+        cfg = dict(self.base_config)
+        cfg.update({
+            "train_micro_batch_size_per_gpu": micro_batch,
+            "gradient_accumulation_steps": 1,
+            "train_batch_size": micro_batch * dp,
+            "zero_optimization": {"stage": zero_stage},
+            "steps_per_print": 10 ** 9,
+        })
+        try:
+            engine, _, _, _ = ds.initialize(model=self.model, config=cfg)
+            rng = np.random.default_rng(0)
+            vocab = self.model.cfg.vocab_size
+
+            def batch():
+                ids = rng.integers(0, vocab, (cfg["train_batch_size"], self.seq_len))
+                return {"input_ids": ids, "labels": ids}
+
+            loss = engine.train_batch(batch())   # compile
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.steps_per_trial):
+                loss = engine.train_batch(batch())
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / self.steps_per_trial
+            return cfg["train_batch_size"] * self.seq_len / dt
+        except Exception as e:
+            logger.warning(f"trial zero={zero_stage} mb={micro_batch} failed: "
+                           f"{str(e)[:120]}")
+            return None
+
+    def tune(self, fast: bool = True) -> Dict[str, Any]:
+        """Run the search; returns the best config patch (reference tune:404)."""
+        info = self.model_info()
+        logger.info(f"autotuning: model={info['num_params'] / 1e6:.1f}M params")
+        stages = [self.stage_candidates[0]] if fast and len(self.stage_candidates) > 1 \
+            else self.stage_candidates
+        best = None
+        for stage in stages:
+            prev = 0.0
+            for mb in self.mb_candidates:
+                tput = self._trial(stage, mb)
+                self.results.append({"zero_stage": stage, "micro_batch": mb,
+                                     "tokens_per_sec": tput})
+                if tput is None:
+                    break            # OOM / failure: larger batches won't fit
+                if best is None or tput > best["tokens_per_sec"]:
+                    best = {"zero_stage": stage, "micro_batch": mb,
+                            "tokens_per_sec": tput}
+                if tput < prev * 1.05:
+                    break            # diminishing returns: stop scaling mb
+                prev = tput
+        if best is None:
+            raise RuntimeError("autotuning: no trial succeeded")
+        logger.info(f"autotuning best: {best}")
+        return {
+            "train_micro_batch_size_per_gpu": best["micro_batch"],
+            "zero_optimization": {"stage": best["zero_stage"]},
+            "autotuning_results": self.results,
+        }

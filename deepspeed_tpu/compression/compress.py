@@ -1,0 +1,108 @@
+"""Model compression entry points.
+
+Analog of ``deepspeed/compression/compress.py`` (init_compression /
+redundancy_clean) + ``basic_layer.py`` quant/prune modules: config-driven
+weight quantization (QAT fake-quant), magnitude pruning, and layer reduction
+applied to a native param pytree.
+"""
+
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+
+
+def fake_quantize(w, bits: int = 8, symmetric: bool = True):
+    """Quantization-aware fake-quant (reference QuantAct/LinearLayer_Compress):
+    round-trip through the integer grid, straight-through in backward."""
+    qmax = 2 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-10) / qmax
+    q = jnp.clip(jnp.round(w / scale), -qmax, qmax)
+    deq = q * scale
+    # straight-through estimator: identity gradient
+    return w + jax.lax.stop_gradient(deq - w)
+
+
+def magnitude_prune(w, sparsity: float):
+    """Zero the smallest-|w| fraction (reference SparsePruning_Compress)."""
+    if sparsity <= 0.0:
+        return w
+    k = int(w.size * sparsity)
+    if k == 0:
+        return w
+    threshold = jnp.sort(jnp.abs(w).reshape(-1))[k - 1]
+    return jnp.where(jnp.abs(w) > threshold, w, 0.0)
+
+
+def head_prune(w_heads, num_keep: int):
+    """Prune attention heads by L2 norm; w_heads: (E, H, D) or (H, D, E)."""
+    axis = 1 if w_heads.shape[0] > w_heads.shape[1] else 0
+    norms = jnp.sqrt(jnp.sum(jnp.square(w_heads), axis=tuple(
+        i for i in range(w_heads.ndim) if i != axis)))
+    keep = jnp.sort(jnp.argsort(norms)[-num_keep:])
+    mask = jnp.zeros((w_heads.shape[axis],)).at[keep].set(1.0)
+    shape = [1] * w_heads.ndim
+    shape[axis] = -1
+    return w_heads * mask.reshape(shape)
+
+
+def _match(path: str, patterns):
+    return any(p in path for p in patterns)
+
+
+def _apply_to_params(params, fn, patterns, prefix=""):
+    if isinstance(params, dict):
+        return {k: _apply_to_params(v, fn, patterns, f"{prefix}{k}.")
+                for k, v in params.items()}
+    if _match(prefix[:-1], patterns):
+        return fn(params)
+    return params
+
+
+def init_compression(model_or_params, deepspeed_config: Dict, teacher_model=None,
+                     mpu=None):
+    """Apply the compression config to a param pytree (reference
+    init_compression). Returns transformed params."""
+    params = model_or_params
+    comp = deepspeed_config.get("compression_training", {})
+
+    wq = comp.get("weight_quantization", {}).get("shared_parameters", {})
+    if wq.get("enabled", False):
+        groups_cfg = comp["weight_quantization"].get("different_groups", {})
+        for gname, g in groups_cfg.items():
+            bits = g.get("params", {}).get("start_bits", 8)
+            mods = g.get("modules", ["attn", "mlp"])
+            params = _apply_to_params(params, lambda w: fake_quantize(w, int(bits)), mods)
+            logger.info(f"compression: fake-quant {bits}b on {mods}")
+
+    sp = comp.get("sparse_pruning", {}).get("shared_parameters", {})
+    if sp.get("enabled", False):
+        groups_cfg = comp["sparse_pruning"].get("different_groups", {})
+        for gname, g in groups_cfg.items():
+            dense_ratio = g.get("params", {}).get("dense_ratio", 0.5)
+            mods = g.get("modules", ["mlp"])
+            params = _apply_to_params(
+                params, lambda w: magnitude_prune(w, 1.0 - float(dense_ratio)), mods)
+            logger.info(f"compression: pruning to dense_ratio={dense_ratio} on {mods}")
+    return params
+
+
+def redundancy_clean(model_or_params, deepspeed_config: Dict, mpu=None):
+    """Layer-reduction (reference redundancy_clean): keep the configured
+    subset of layers from the stacked layer dim."""
+    params = model_or_params
+    lr_cfg = deepspeed_config.get("compression_training", {}).get("layer_reduction", {})
+    if not lr_cfg.get("enabled", False):
+        return params
+    keep = lr_cfg.get("keep_layers")
+    if keep is None:
+        n = lr_cfg.get("keep_number_layer")
+        total = jax.tree.leaves(params["layers"])[0].shape[0]
+        keep = list(range(0, total, max(1, total // n)))[:n]
+    keep_idx = jnp.asarray(keep)
+    params = dict(params)
+    params["layers"] = jax.tree.map(lambda x: x[keep_idx], params["layers"])
+    logger.info(f"layer reduction: kept layers {list(keep)}")
+    return params

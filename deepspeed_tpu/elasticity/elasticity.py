@@ -1,0 +1,113 @@
+"""Elastic training configuration math.
+
+Analog of ``deepspeed/elasticity/elasticity.py`` (``compute_elastic_config:
+233``, candidate batch/GPU math ``:27-126``): precompute batch sizes valid
+across a range of accelerator counts so scaling events keep
+batch-size-sensitive hyperparameters fixed. Pure math — identical semantics.
+"""
+
+from typing import Dict, List, Tuple
+
+from ..utils.logging import logger
+
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
+    """All batch sizes b = base * 2^k ≤ max, deduped ascending (ref ``:27``)."""
+    candidates = set()
+    for base in base_list:
+        b = base
+        while b <= max_acceptable_batch_size:
+            candidates.add(b)
+            b *= 2
+    return sorted(candidates)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int,
+                   max_valid_gpus: int) -> List[int]:
+    """GPU counts g where batch_size % (g * mb) == 0 for some micro batch
+    (ref ``:44``)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb != 0:
+            continue
+        max_gpus = batch_size // mb
+        for g in range(1, max_gpus + 1):
+            if batch_size % (g * mb) == 0 and min_valid_gpus <= g <= max_valid_gpus:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[int],
+                        min_gpus: int, max_gpus: int, prefer_larger: bool):
+    """(batch, valid_gpus) maximizing GPU-count coverage (ref ``:63``)."""
+    max_valid = 0
+    best_batch = None
+    best_gpus = []
+    for batch in candidate_batch_sizes:
+        gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        if len(gpus) > max_valid or (len(gpus) == max_valid and prefer_larger and
+                                     best_batch is not None and batch > best_batch):
+            max_valid = len(gpus)
+            best_batch = batch
+            best_gpus = gpus
+    return best_batch, best_gpus
+
+
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=1,
+                             max_gpus=10000, prefer_larger=True):
+    candidates = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+    return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+
+def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference ``:233``: resolve final batch config from the elasticity block."""
+    elastic = ds_config.get("elasticity")
+    if elastic is None:
+        raise ElasticityConfigError("'elasticity' block missing from config")
+    if not elastic.get("enabled", False):
+        raise ElasticityConfigError("elasticity.enabled is false")
+    micro_batches = elastic.get("micro_batch_sizes", [])
+    max_batch = elastic.get("max_train_batch_size", 0)
+    min_gpus = elastic.get("min_gpus", 1)
+    max_gpus = elastic.get("max_gpus", 10000)
+    prefer_larger = elastic.get("prefer_larger_batch", True)
+    if not micro_batches or max_batch <= 0:
+        raise ElasticityConfigError("micro_batch_sizes and max_train_batch_size required")
+
+    final_batch, valid_gpus = _get_compatible_gpus_v01(
+        micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)
+    if final_batch is None:
+        raise ElasticityConfigError("no compatible batch size found")
+
+    if world_size > 0:
+        if world_size not in valid_gpus:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {world_size} not in valid set {valid_gpus}")
+        mb = None
+        order = sorted(micro_batches, reverse=prefer_larger)
+        for candidate in order:
+            if final_batch % (world_size * candidate) == 0:
+                mb = candidate
+                break
+        if return_microbatch:
+            return final_batch, valid_gpus, mb
+        return final_batch, valid_gpus
+
+    if return_microbatch:
+        return final_batch, valid_gpus, None
+    return final_batch, valid_gpus

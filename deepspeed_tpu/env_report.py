@@ -1,0 +1,72 @@
+"""Environment / op-compatibility report.
+
+Analog of ``deepspeed/env_report.py:183`` (``ds_report`` CLI): prints
+platform, jax/runtime versions, device inventory, and per-op build/compat
+status from the op-builder registry.
+"""
+
+import sys
+
+GREEN = "\033[92m"
+RED = "\033[91m"
+YELLOW = "\033[93m"
+END = "\033[0m"
+OKAY = f"{GREEN}[OKAY]{END}"
+NO = f"{YELLOW}[NO]{END}"
+
+
+def op_report(verbose=False):
+    from .ops.op_builder import ALL_OPS
+    lines = ["-" * 74,
+             "op name" + " " * 23 + "kind" + " " * 12 + "compatible",
+             "-" * 74]
+    for name, cls in sorted(ALL_OPS.items()):
+        b = cls()
+        kind = "pallas" if "Pallas" in type(b).__mro__[1].__name__ else "native"
+        ok = b.is_compatible(verbose=verbose)
+        lines.append(f"{b.name:<30}{kind:<16}{OKAY if ok else NO}"
+                     + (f"  {b.error_log}" if (not ok and b.error_log) else ""))
+    return "\n".join(lines)
+
+
+def env_info():
+    import jax
+    lines = ["-" * 74, "DeepSpeed-TPU general environment info:", "-" * 74]
+    import deepspeed_tpu
+    lines.append(f"deepspeed_tpu version ....... {deepspeed_tpu.__version__}")
+    lines.append(f"python version .............. {sys.version.split()[0]}")
+    lines.append(f"jax version ................. {jax.__version__}")
+    try:
+        import jaxlib
+        lines.append(f"jaxlib version .............. {jaxlib.__version__}")
+    except Exception:
+        pass
+    lines.append(f"default backend ............. {jax.default_backend()}")
+    try:
+        devs = jax.devices()
+        lines.append(f"devices ..................... {len(devs)} x {devs[0].device_kind}")
+        mems = {m.kind for m in devs[0].addressable_memories()}
+        lines.append(f"memory spaces ............... {sorted(mems)}")
+    except Exception as e:
+        lines.append(f"devices ..................... unavailable ({e})")
+    for mod in ("flax", "optax", "orbax.checkpoint", "numpy"):
+        try:
+            m = __import__(mod)
+            lines.append(f"{mod:<28}. {getattr(m, '__version__', '?')}")
+        except Exception:
+            lines.append(f"{mod:<28}. not installed")
+    return "\n".join(lines)
+
+
+def main(verbose=True):
+    print(op_report(verbose=False))
+    print(env_info())
+    return 0
+
+
+def cli_main():
+    sys.exit(main())
+
+
+if __name__ == "__main__":
+    cli_main()

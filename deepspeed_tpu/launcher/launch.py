@@ -1,0 +1,37 @@
+"""Per-node process spawner.
+
+Analog of ``deepspeed/launcher/launch.py`` (``main:133``): spawns ``nproc``
+worker processes with RANK/LOCAL_RANK/WORLD_SIZE set from the env the runner
+exported; workers call ``deepspeed_tpu.init_distributed`` which feeds those
+into ``jax.distributed.initialize``.
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nproc", type=int, default=1)
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    rank_offset = int(os.environ.get("RANK_OFFSET", 0))
+    procs = []
+    for local_rank in range(args.nproc):
+        env = dict(os.environ)
+        env["LOCAL_RANK"] = str(local_rank)
+        env["RANK"] = str(rank_offset + local_rank)
+        procs.append(subprocess.Popen([sys.executable, args.script] + args.script_args,
+                                      env=env))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

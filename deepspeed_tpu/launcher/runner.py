@@ -1,0 +1,178 @@
+"""Multi-host launcher CLI.
+
+Analog of ``deepspeed/launcher/runner.py`` (``main:398``, hostfile parsing
+``:210-255``, ``--include/--exclude`` filters ``:265``) and
+``multinode_runner.py``. Differences from the reference are TPU-shaped:
+worker processes rendezvous through ``jax.distributed`` (coordinator address
+= first host) instead of torch.distributed; on Cloud TPU pods the runtime
+discovers peers via metadata, so the launcher's job is mostly env setup +
+fan-out (pdsh / ssh / mpirun / local).
+
+Usage:
+    dstpu --hostfile hosts.txt [--include w1@host1] train.py --args
+    dstpu --num_nodes 1 --num_gpus 8 train.py        # local spawn
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+from collections import OrderedDict
+
+from ..utils.logging import logger
+
+DLTS_HOSTFILE = "/job/hostfile"
+EXPORT_ENVS = ["PYTHONPATH", "PATH", "LD_LIBRARY_PATH", "JAX_PLATFORMS", "TPU_CHIPS_PER_HOST_BOUNDS"]
+
+
+def parse_hostfile(path):
+    """'hostname slots=N' lines → OrderedDict host → slots (reference :210)."""
+    resource_pool = OrderedDict()
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"hostfile {path} not found")
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 1
+            for p in parts[1:]:
+                if p.startswith("slots="):
+                    slots = int(p.split("=")[1])
+            if host in resource_pool:
+                raise ValueError(f"duplicate host {host} in hostfile")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def parse_inclusion_exclusion(resource_pool, include_str="", exclude_str=""):
+    """'host1@host2:0,2' filters (reference :265)."""
+
+    def parse_filter(s):
+        mapping = {}
+        for item in (s or "").split("@"):
+            item = item.strip()
+            if not item:
+                continue
+            if ":" in item:
+                host, slots = item.split(":")
+                mapping[host] = [int(x) for x in slots.split(",")]
+            else:
+                mapping[item] = None
+        return mapping
+
+    include = parse_filter(include_str)
+    exclude = parse_filter(exclude_str)
+    if include and exclude:
+        raise ValueError("--include and --exclude are mutually exclusive")
+
+    active = OrderedDict()
+    if include:
+        for host, slots in include.items():
+            if host not in resource_pool:
+                raise ValueError(f"included host {host} not in hostfile")
+            n = resource_pool[host]
+            active[host] = slots if slots is not None else list(range(n))
+    else:
+        for host, n in resource_pool.items():
+            all_slots = list(range(n))
+            if host in exclude:
+                drop = exclude[host]
+                if drop is None:
+                    continue
+                all_slots = [s for s in all_slots if s not in drop]
+            if all_slots:
+                active[host] = all_slots
+    return active
+
+
+def encode_world_info(active_resources):
+    import base64
+    import json
+    return base64.urlsafe_b64encode(json.dumps(active_resources).encode()).decode()
+
+
+def build_launch_cmds(args, active_resources, user_script, user_args):
+    """One command per node (pdsh/ssh fan-out or local exec)."""
+    hosts = list(active_resources)
+    master = args.master_addr or hosts[0]
+    world_size = sum(len(s) for s in active_resources.values())
+    cmds = []
+    rank_offset = 0
+    for host, slots in active_resources.items():
+        env = {
+            "MASTER_ADDR": master,
+            "MASTER_PORT": str(args.master_port),
+            "WORLD_SIZE": str(world_size),
+            "NNODES": str(len(hosts)),
+            "NODE_RANK": str(hosts.index(host)),
+            "RANK_OFFSET": str(rank_offset),
+        }
+        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+        for k in EXPORT_ENVS:
+            if k in os.environ:
+                exports += f" {k}={shlex.quote(os.environ[k])}"
+        launch = (f"{exports} {sys.executable} -m deepspeed_tpu.launcher.launch "
+                  f"--nproc {len(slots)} {shlex.quote(user_script)} "
+                  + " ".join(shlex.quote(a) for a in user_args))
+        cmds.append((host, launch))
+        rank_offset += len(slots)
+    return cmds
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(description="deepspeed_tpu launcher")
+    parser.add_argument("--hostfile", type=str, default=DLTS_HOSTFILE)
+    parser.add_argument("--include", type=str, default="")
+    parser.add_argument("--exclude", type=str, default="")
+    parser.add_argument("--num_nodes", type=int, default=-1)
+    parser.add_argument("--num_gpus", "--num_chips", type=int, default=-1, dest="num_gpus")
+    parser.add_argument("--master_addr", type=str, default=None)
+    parser.add_argument("--master_port", type=int, default=29500)
+    parser.add_argument("--launcher", type=str, default="pdsh",
+                        choices=["pdsh", "ssh", "openmpi", "local"])
+    parser.add_argument("--force_multi", action="store_true")
+    parser.add_argument("--dry_run", action="store_true",
+                        help="print the per-node commands without executing")
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if os.path.isfile(args.hostfile):
+        pool = parse_hostfile(args.hostfile)
+    else:
+        n = args.num_gpus if args.num_gpus > 0 else 1
+        pool = OrderedDict([("localhost", n)])
+    active = parse_inclusion_exclusion(pool, args.include, args.exclude)
+    if args.num_nodes > 0:
+        active = OrderedDict(list(active.items())[:args.num_nodes])
+    cmds = build_launch_cmds(args, active, args.user_script, args.user_args)
+
+    if args.dry_run:
+        for host, cmd in cmds:
+            print(f"[{host}] {cmd}")
+        return 0
+
+    if len(cmds) == 1 and list(active)[0] == "localhost":
+        host, cmd = cmds[0]
+        return subprocess.call(cmd, shell=True)
+
+    procs = []
+    for host, cmd in cmds:
+        if args.launcher == "pdsh":
+            full = f"pdsh -w {host} {shlex.quote(cmd)}"
+        else:
+            full = f"ssh {host} {shlex.quote(cmd)}"
+        logger.info(f"launching on {host}")
+        procs.append(subprocess.Popen(full, shell=True))
+    rc = 0
+    for p in procs:
+        rc |= p.wait()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,67 @@
+"""Random layerwise token dropping (random-LTD).
+
+Analog of ``deepspeed/runtime/data_pipeline/data_routing/basic_layer.py:14``
+(RandomLayerTokenDrop): during training, middle layers process a random
+subset of tokens; the dropped tokens bypass the layer. On TPU the gather/
+scatter are plain jnp ops (the reference's ``csrc/random_ltd`` kernels are
+unnecessary — SURVEY §2.2 maps them to XLA gather/argsort).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def random_token_select(rng, seq_len: int, keep: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Sample ``keep`` sorted token indices out of ``seq_len``; returns
+    (kept_idx (keep,), mask (seq_len,) bool)."""
+    scores = jax.random.uniform(rng, (seq_len,))
+    kept = jnp.sort(jnp.argsort(scores)[:keep])
+    mask = jnp.zeros((seq_len,), bool).at[kept].set(True)
+    return kept, mask
+
+
+def gather_tokens(x, kept_idx):
+    """x: (B, S, E) → (B, keep, E)."""
+    return jnp.take(x, kept_idx, axis=1)
+
+
+def scatter_tokens(full, processed, kept_idx):
+    """Insert processed (B, keep, E) back into full (B, S, E) at kept_idx."""
+    return full.at[:, kept_idx].set(processed)
+
+
+class RandomLayerTokenDrop:
+    """Wraps a layer fn: processes a random token subset, passes the rest
+    through the residual stream."""
+
+    def __init__(self, layer_fn, keep_ratio: float = 0.5):
+        self.layer_fn = layer_fn
+        self.keep_ratio = keep_ratio
+
+    def __call__(self, params, x, rng, train: bool = True):
+        if not train or self.keep_ratio >= 1.0:
+            return self.layer_fn(params, x)
+        s = x.shape[1]
+        keep = max(1, int(s * self.keep_ratio))
+        kept_idx, _ = random_token_select(rng, s, keep)
+        sub = gather_tokens(x, kept_idx)
+        sub_out = self.layer_fn(params, sub)
+        return scatter_tokens(x, sub_out, kept_idx)
+
+
+class RandomLTDScheduler:
+    """Reserved-token ramp (reference data_routing/scheduler.py): the kept
+    token count grows linearly from min to full over the schedule."""
+
+    def __init__(self, total_layers: int, min_tokens: int, max_tokens: int,
+                 schedule_steps: int):
+        self.total_layers = total_layers
+        self.min_tokens = min_tokens
+        self.max_tokens = max_tokens
+        self.schedule_steps = schedule_steps
+
+    def tokens_at(self, step: int) -> int:
+        frac = min(1.0, step / max(1, self.schedule_steps))
+        return int(self.min_tokens + frac * (self.max_tokens - self.min_tokens))

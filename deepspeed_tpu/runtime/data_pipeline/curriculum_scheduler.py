@@ -1,0 +1,78 @@
+"""Curriculum learning scheduler.
+
+Analog of ``deepspeed/runtime/data_pipeline/curriculum_scheduler.py``
+(CurriculumScheduler): difficulty (e.g. sequence length) ramps with steps
+under fixed_linear / fixed_root / fixed_discrete / custom schedules.
+"""
+
+import math
+from typing import Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict):
+        self.state = {}
+        assert "curriculum_type" in config, "curriculum_type required"
+        assert "min_difficulty" in config and "max_difficulty" in config
+        self.state["min_difficulty"] = config["min_difficulty"]
+        self.state["max_difficulty"] = config["max_difficulty"]
+        self.state["current_difficulty"] = config["min_difficulty"]
+        self.state["schedule_type"] = config["curriculum_type"]
+        self.custom_get_difficulty: Optional[Callable] = None
+        cfg = config.get("schedule_config", {})
+        stype = config["curriculum_type"]
+        if stype in (FIXED_LINEAR, FIXED_ROOT):
+            assert "total_curriculum_step" in cfg and "difficulty_step" in cfg
+            self.state["schedule"] = dict(cfg)
+            if stype == FIXED_ROOT:
+                self.state["schedule"].setdefault("root_degree", 2)
+        elif stype == FIXED_DISCRETE:
+            assert "difficulty" in cfg and "max_step" in cfg
+            assert len(cfg["max_step"]) == len(cfg["difficulty"]) - 1
+            self.state["schedule"] = dict(cfg)
+        elif stype == CUSTOM:
+            pass
+        else:
+            raise ValueError(f"unknown curriculum_type {stype}")
+
+    def get_current_difficulty(self):
+        return self.state["current_difficulty"]
+
+    def set_custom_get_difficulty(self, fn: Callable):
+        self.custom_get_difficulty = fn
+
+    def update_difficulty(self, global_steps: int):
+        s = self.state
+        stype = s["schedule_type"]
+        if stype == CUSTOM:
+            assert self.custom_get_difficulty is not None
+            d = self.custom_get_difficulty(global_steps)
+        elif stype == FIXED_DISCRETE:
+            cfg = s["schedule"]
+            d = cfg["difficulty"][-1]
+            for i, max_step in enumerate(cfg["max_step"]):
+                if global_steps <= max_step:
+                    d = cfg["difficulty"][i]
+                    break
+        else:
+            cfg = s["schedule"]
+            frac = min(1.0, global_steps / cfg["total_curriculum_step"])
+            if stype == FIXED_ROOT:
+                frac = frac ** (1.0 / cfg["root_degree"])
+            d = s["min_difficulty"] + frac * (s["max_difficulty"] - s["min_difficulty"])
+            step = cfg["difficulty_step"]
+            d = int(d / step) * step
+        s["current_difficulty"] = max(s["min_difficulty"],
+                                      min(int(d), s["max_difficulty"]))
+        return s["current_difficulty"]
+
+    def state_dict(self):
+        return dict(self.state)
+
+    def load_state_dict(self, sd):
+        self.state.update(sd)

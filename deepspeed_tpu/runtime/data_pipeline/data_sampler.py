@@ -1,0 +1,73 @@
+"""Distributed curriculum-aware data sampling.
+
+Analog of ``deepspeed/runtime/data_pipeline/data_sampling/data_sampler.py:36``
+(DeepSpeedDataSampler): deterministic epoch shuffling, per-dp-rank slicing,
+optional curriculum (difficulty-filtered index pools).
+"""
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, total_samples: int, micro_batch_size: int,
+                 data_parallel_rank: int = 0, data_parallel_size: int = 1,
+                 gradient_accumulation_steps: int = 1, drop_last: bool = True,
+                 shuffle: bool = True, seed: int = 0,
+                 curriculum_scheduler=None, difficulty_of=None):
+        self.total_samples = total_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.gas = gradient_accumulation_steps
+        self.drop_last = drop_last
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.consumed_samples = 0
+        self.curriculum = curriculum_scheduler
+        self.difficulty_of = difficulty_of   # sample_idx -> difficulty metric
+        self.global_batch_size = micro_batch_size * self.dp_size * self.gas
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.total_samples // self.global_batch_size * self.gas
+        return (self.total_samples + self.global_batch_size - 1) // self.global_batch_size * self.gas
+
+    def _indices(self):
+        idx = np.arange(self.total_samples)
+        if self.curriculum is not None and self.difficulty_of is not None:
+            d = self.curriculum.get_current_difficulty()
+            idx = idx[np.asarray([self.difficulty_of(int(i)) <= d for i in idx])]
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + self.epoch)
+            rng.shuffle(idx)
+        return idx
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        idx = self._indices()
+        n_batches = len(idx) // self.global_batch_size if self.drop_last else \
+            (len(idx) + self.global_batch_size - 1) // self.global_batch_size
+        for b in range(n_batches):
+            chunk = idx[b * self.global_batch_size:(b + 1) * self.global_batch_size]
+            # per-microbatch slices for this dp rank
+            for g in range(self.gas):
+                lo = g * self.micro_batch_size * self.dp_size + self.dp_rank * self.micro_batch_size
+                mb = chunk[lo:lo + self.micro_batch_size]
+                if len(mb) == 0:
+                    continue
+                self.consumed_samples += len(mb) * self.dp_size
+                yield mb
+
+    def state_dict(self):
+        return {"epoch": self.epoch, "consumed_samples": self.consumed_samples,
+                "seed": self.seed}
+
+    def load_state_dict(self, sd):
+        self.epoch = sd["epoch"]
+        self.consumed_samples = sd["consumed_samples"]
+        self.seed = sd.get("seed", self.seed)

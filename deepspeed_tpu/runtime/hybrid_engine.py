@@ -1,0 +1,83 @@
+"""Hybrid engine: training + fast generation (RLHF).
+
+Analog of ``deepspeed/runtime/hybrid_engine.py:32`` (DeepSpeedHybridEngine):
+the reference flips ZeRO-3 training params into inference kernel containers
+for the RLHF generate phase and back. Here both phases share one param
+pytree — generation jit-compiles a decode loop against the live (sharded)
+training params, so "flipping" is zero-copy: no gather, no re-layout, the
+decode program reads the same buffers the train step updates.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..inference.sampling import sample_logits
+from ..models.transformer import CausalLM
+from ..utils.logging import log_dist
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """Training engine + generate() for actor models in RLHF loops."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        assert isinstance(self.model, CausalLM), \
+            "hybrid engine requires a native CausalLM"
+        self._decode_fn = None
+        self._gather_count = 0
+
+    def eval(self):
+        return self
+
+    def train(self, mode=True):
+        return self
+
+    def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 1.0,
+                 top_k: int = 0, top_p: float = 1.0, eos_token_id: Optional[int] = None,
+                 seed: int = 0, **kwargs):
+        """Sampled generation on the CURRENT training params (the RLHF
+        experience-collection phase, reference :156 generate)."""
+        ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
+        b, s_prompt = ids.shape
+        cache = self.model.init_cache(b, s_prompt + max_new_tokens)
+        if self._decode_fn is None:
+            @jax.jit
+            def decode(params, tok, cache, cache_len):
+                return self.model.apply_decode(params, tok, cache, cache_len)
+            self._decode_fn = decode
+
+        cache_len = jnp.zeros((b,), jnp.int32)
+        logits, cache = self._decode_fn(self.module_params, ids, cache, cache_len)
+        cache_len = cache_len + s_prompt
+        rng = jax.random.PRNGKey(seed + self.global_steps)
+        rng, sub = jax.random.split(rng)
+        tok = sample_logits(logits[:, -1].astype(jnp.float32), sub,
+                            temperature=temperature, top_k=top_k, top_p=top_p,
+                            greedy=temperature == 0.0)
+        toks = [tok]
+        done = jnp.zeros((b,), bool)
+        for _ in range(max_new_tokens - 1):
+            logits, cache = self._decode_fn(self.module_params, tok[:, None], cache, cache_len)
+            cache_len = cache_len + 1
+            rng, sub = jax.random.split(rng)
+            tok = sample_logits(logits[:, -1].astype(jnp.float32), sub,
+                                temperature=temperature, top_k=top_k, top_p=top_p,
+                                greedy=temperature == 0.0)
+            if eos_token_id is not None:
+                tok = jnp.where(done, eos_token_id, tok)
+                done = done | (tok == eos_token_id)
+            toks.append(tok)
+        return jnp.concatenate([ids, jnp.stack(toks, axis=1)], axis=1)
+
+
+def initialize_hybrid(model=None, config=None, **kwargs):
+    """deepspeed.initialize-shaped constructor for RLHF actors."""
+    import deepspeed_tpu as ds
+    from ..runtime.config import DeepSpeedConfig
+    ds.init_distributed(verbose=False)
+    engine = DeepSpeedHybridEngine(model=model, config=config, **kwargs)
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler

@@ -1,0 +1,74 @@
+"""Consolidate a checkpoint into a single fp32 state dict.
+
+Analog of ``deepspeed/utils/zero_to_fp32.py`` (shipped inside every reference
+checkpoint dir, ``engine.py:3509``): offline conversion of a saved checkpoint
+into a flat {name: fp32 ndarray} mapping usable without the framework. Orbax
+checkpoints already store logical arrays, so consolidation = load + cast +
+flatten; also callable as a script:
+
+    python -m deepspeed_tpu.utils.zero_to_fp32 <ckpt_dir> <out.npz>
+"""
+
+import json
+import os
+import sys
+from typing import Dict
+
+import numpy as np
+
+from .logging import logger
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    else:
+        out[prefix[:-1]] = tree
+    return out
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag=None) -> Dict[str, np.ndarray]:
+    """Load <dir>/<tag or latest>/ and return {param_path: fp32 array}."""
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.isfile(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            tag = ""
+    path = os.path.join(checkpoint_dir, str(tag)) if tag else checkpoint_dir
+
+    state = None
+    if os.path.isfile(os.path.join(path, "state.npz")):
+        from ..runtime.checkpoint_engine.orbax_engine import NumpyCheckpointEngine
+        state = NumpyCheckpointEngine().load(path)
+        module = state["module"]
+    else:
+        import orbax.checkpoint as ocp
+        ckptr = ocp.StandardCheckpointer()
+        state = ckptr.restore(os.path.abspath(path))
+        module = state["module"]
+    flat = _flatten(module)
+    return {k: np.asarray(v, dtype=np.float32) for k, v in flat.items()}
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
+                                               tag=None):
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    np.savez(output_file, **sd)
+    total = sum(v.size for v in sd.values())
+    logger.info(f"saved {len(sd)} tensors / {total / 1e6:.1f}M params → {output_file}")
+    return output_file
+
+
+def main():
+    if len(sys.argv) != 3:
+        print(__doc__)
+        sys.exit(1)
+    convert_zero_checkpoint_to_fp32_state_dict(sys.argv[1], sys.argv[2])
+
+
+if __name__ == "__main__":
+    main()
