@@ -1,0 +1,64 @@
+#!/usr/bin/env python
+"""Collective bandwidth benchmark (ICI allgather / reduce-scatter / all-reduce
+/ all-to-all) — one of the BASELINE.json metrics.
+
+Analog of the reference's ``ds_bench`` / DeepSpeedExamples comm benchmarks:
+sweeps message sizes, reports algorithmic bandwidth per collective.
+
+Usage: python benchmarks/comm_bench.py [--sizes 1048576,16777216] [--trials 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(sizes, trials):
+    import jax
+    import jax.numpy as jnp
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.utils import groups
+
+    dist.init_distributed(verbose=False)
+    n = groups.get_world_size()
+    results = []
+    for size in sizes:
+        x = jnp.ones((size // 4,), jnp.float32)  # size bytes
+        for name, fn, vol_factor in (
+                ("all_reduce", lambda t: dist.all_reduce(t, group="data"), 2 * (n - 1) / n),
+                ("all_gather", lambda t: dist.all_gather_into_tensor(
+                    jax.device_put(t, groups.named_sharding("data")), group="data"),
+                 (n - 1) / n),
+                ("reduce_scatter", lambda t: dist.reduce_scatter_tensor(t, group="data"),
+                 (n - 1) / n),
+                ("all_to_all", lambda t: dist.all_to_all_single(
+                    jax.device_put(t, groups.named_sharding("data")), group="data"),
+                 (n - 1) / n),
+        ):
+            out = fn(x)
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            outs = [fn(x) for _ in range(trials)]
+            jax.block_until_ready(outs)
+            dt = (time.perf_counter() - t0) / trials
+            busbw = size * vol_factor / dt / 1e9
+            results.append({"op": name, "bytes": size, "time_us": round(dt * 1e6, 1),
+                            "busbw_GBps": round(busbw, 2)})
+    return {"world": n, "results": results}
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sizes", type=str, default="1048576,16777216,134217728")
+    p.add_argument("--trials", type=int, default=20)
+    args = p.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+    print(json.dumps(run(sizes, args.trials)))
+
+
+if __name__ == "__main__":
+    main()

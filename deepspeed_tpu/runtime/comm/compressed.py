@@ -1,0 +1,98 @@
+"""Error-feedback compressed (1-bit) allreduce.
+
+Analog of ``deepspeed/runtime/comm/compressed.py:13`` (CompressedBackend)
+and ``runtime/comm/nccl.py:51`` (compressed_allreduce): signs + per-chunk
+scale travel the wire; the residual between the true value and its
+compression is fed back into the next round's input, preserving convergence
+(1-bit Adam/LAMB's communication layer).
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...utils import groups
+
+
+def compressed_allreduce_body(x, worker_error, server_error,
+                              axis_name: str = "data"):
+    """Inside shard_map: 1-bit allreduce with worker AND server error
+    feedback (reference keeps both buffers, ``runtime/comm/nccl.py:51``).
+
+    Stage 1 (compress + exchange): each rank compresses (x + worker_error)
+    to sign·scale; sign chunks + scales travel.
+    Stage 2 (server): local dequant-sum of this rank's chunk, second
+    compression with server_error feedback, allgather.
+    Returns (allreduced approximation, new_worker_error, new_server_error).
+    """
+    n = jax.lax.axis_size(axis_name)
+    corrected = x.astype(jnp.float32) + worker_error
+    scale = jnp.mean(jnp.abs(corrected))
+    signs = jnp.sign(corrected).astype(jnp.int8)
+    new_worker_error = corrected - scale * signs.astype(jnp.float32)
+
+    pad = (-signs.size) % n
+    flat = signs.reshape(-1)
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.int8)])
+    chunks = flat.reshape(n, -1)
+    sign_x = jax.lax.all_to_all(chunks, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    scale_all = jax.lax.all_gather(scale.reshape(1), axis_name, axis=0, tiled=True)  # (n,)
+    contrib = sign_x.reshape(n, -1).astype(jnp.float32) * scale_all[:, None]
+    reduced_chunk = jnp.sum(contrib, axis=0)                     # (chunk,)
+    # second compression, with server error feedback on this rank's chunk
+    corrected2 = reduced_chunk + server_error
+    scale2 = jnp.mean(jnp.abs(corrected2))
+    signs2 = jnp.sign(corrected2).astype(jnp.int8)
+    new_server_error = corrected2 - scale2 * signs2.astype(jnp.float32)
+    signs2_all = jax.lax.all_gather(signs2, axis_name, axis=0, tiled=True)
+    scale2_all = jax.lax.all_gather(scale2.reshape(1), axis_name, axis=0, tiled=True)
+    full = signs2_all.reshape(n, -1).astype(jnp.float32) * scale2_all[:, None]
+    full = full.reshape(-1)
+    if pad:
+        full = full[:signs.size]
+    return full.reshape(x.shape), new_worker_error, new_server_error
+
+
+class CompressedBackend:
+    """Eager facade (reference CompressedBackend): maintains per-buffer error
+    feedback state and runs the compressed allreduce over the mesh.
+
+    Single-controller convention: ``buffer`` carries per-rank contributions
+    stacked on a leading dim of size n (sharded over the axis); the result is
+    the same shape, every slot holding that rank's allreduced approximation.
+    """
+
+    def __init__(self, axis_name: str = "data"):
+        self.axis_name = axis_name
+        self._errors = {}
+
+    def compressed_allreduce(self, buffer, key: str = "default"):
+        mesh = groups.get_mesh()
+        n = mesh.shape.get(self.axis_name, 1)
+        if n <= 1:
+            return buffer
+        assert buffer.shape[0] == n, \
+            f"leading dim {buffer.shape[0]} must equal axis size {n}"
+        chunk = (buffer[0].size + n - 1) // n
+        state = self._errors.get(key)
+        if state is None or state[0].shape != buffer.shape:
+            state = (jnp.zeros(buffer.shape, jnp.float32),
+                     jnp.zeros((n, chunk), jnp.float32))
+        w_err, s_err = state
+
+        def body(x, we, se):
+            out, new_we, new_se = compressed_allreduce_body(
+                x[0], we[0], se[0], self.axis_name)
+            return out[None], new_we[None], new_se[None]
+
+        fn = jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name)),
+            out_specs=(P(self.axis_name), P(self.axis_name), P(self.axis_name)),
+            axis_names={self.axis_name}, check_vma=True)
+        out, new_we, new_se = fn(buffer, w_err, s_err)
+        self._errors[key] = (new_we, new_se)
+        return out

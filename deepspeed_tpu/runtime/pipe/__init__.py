@@ -1,0 +1,4 @@
+"""Pipeline parallelism public API (reference ``deepspeed.pipe``)."""
+
+from .module import LayerSpec, PipelineModule, TiedLayerSpec  # noqa: F401
+from .schedule import InferenceSchedule, TrainSchedule  # noqa: F401

@@ -1,0 +1,66 @@
+"""ZeRO public API.
+
+Analog of ``deepspeed/runtime/zero/__init__.py``: exports the config and the
+``zero.Init`` context. In the reference, ``Init`` patches ``nn.Module`` so
+parameters are partitioned at construction (``partition_parameters.py:808``);
+here parameters are BORN sharded — ``DeepSpeedEngine`` jits ``model.init``
+with ZeRO out-shardings, so the full tensor never materializes on any chip.
+``Init`` therefore only records config for API compatibility and provides
+the gather context used by code that needs temporarily-full params.
+"""
+
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .config import DeepSpeedZeroConfig  # noqa: F401
+
+
+class Init:
+    """API-parity context (reference ``zero.Init``). Model construction under
+    this context behaves identically outside it (sharded-at-birth is the
+    default); kwargs are accepted and recorded."""
+
+    def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
+                 remote_device=None, pin_memory=False, config_dict_or_path=None,
+                 config=None, enabled=True, dtype=None, mpu=None, param_swapper=None):
+        self.enabled = enabled
+        self.config = config_dict_or_path or config
+        self.dtype = dtype
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class GatheredParameters:
+    """Analog of ``zero.GatheredParameters``: within the context, hand back
+    fully-replicated copies of the given (possibly sharded) arrays."""
+
+    def __init__(self, params, modifier_rank=None, fwd_module=None, enabled=True):
+        self.params = params
+        self.enabled = enabled
+        self.gathered = None
+
+    def __enter__(self):
+        if not self.enabled:
+            return self.params
+        from ...utils import groups
+        mesh = groups.get_mesh()
+        replicated = NamedSharding(mesh, P())
+
+        def gather(x):
+            return jax.device_put(x, replicated)
+
+        self.gathered = jax.tree.map(gather, self.params)
+        return self.gathered
+
+    def __exit__(self, *exc):
+        return False
+
+
+def unwrap_model_for_generation(model):
+    return model

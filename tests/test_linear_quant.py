@@ -1,0 +1,106 @@
+"""ZeRO-Inference quantized layers + DS-LoRA + activation checkpointing tests
+(reference: tests/unit/inference/quantization, tests/unit/linear)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+pytestmark = pytest.mark.usefixtures("mesh_8dp")
+
+
+def test_quantized_parameter_roundtrip(rng):
+    from deepspeed_tpu.inference.quantization.layers import QuantizedParameter
+    w = jax.random.normal(rng, (64, 48))
+    for bits in (8, 4):
+        qp = QuantizedParameter.quantize(w, bits=bits, group_size=64)
+        back = qp.dequantized()
+        assert back.shape == w.shape
+        tol = float(jnp.max(jnp.abs(w))) / (127 if bits == 8 else 7) * 1.1
+        assert float(jnp.max(jnp.abs(back - w))) < tol
+
+
+def test_quantized_linear_close(rng):
+    from deepspeed_tpu.inference.quantization.layers import QuantizedLinear
+    w = jax.random.normal(rng, (32, 16))
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (4, 32))
+    lin = QuantizedLinear(w, bits=8, group_size=64)
+    got = lin(x)
+    want = x @ w
+    assert float(jnp.max(jnp.abs(got - want))) < 0.15 * float(jnp.max(jnp.abs(want)))
+
+
+def test_quantize_model_params(rng):
+    from deepspeed_tpu.inference.quantization.layers import (dequantize_model_params,
+                                                             quantize_model_params)
+    from deepspeed_tpu.models import build_model
+    model = build_model("tiny")
+    params = model.init(rng)
+    qparams = quantize_model_params(params, bits=8, min_size=1024)
+    deq = dequantize_model_params(qparams)
+    ids = jnp.zeros((1, 8), jnp.int32)
+    ref = model.apply(params, ids)
+    got = model.apply(deq, ids)
+    assert jnp.all(jnp.isfinite(got))
+    # quantized model stays predictive-close on logit scale
+    assert float(jnp.mean(jnp.abs(got - ref))) < 0.2
+
+
+def test_lora_linear(rng):
+    from deepspeed_tpu.linear.optimized_linear import LoRAConfig, OptimizedLinear
+    lin = OptimizedLinear(32, 16, lora_config=LoRAConfig(lora_r=4, lora_alpha=8))
+    params = lin.init(rng)
+    x = jax.random.normal(rng, (4, 32))
+    y = lin.apply(params, x)
+    assert y.shape == (4, 16)
+    # lora_b starts at zero → output equals frozen base
+    base_y = x @ params["base"].astype(x.dtype)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(base_y), atol=1e-5)
+    # base is frozen: grads flow only to adapters
+    g = jax.grad(lambda p: jnp.sum(lin.apply(p, x) ** 2))(params)
+    assert float(jnp.max(jnp.abs(g["base"]))) == 0.0
+    # with B=0, gradient reaches B first (dL/dB = (xA)^T g); A follows later
+    assert float(jnp.max(jnp.abs(g["lora_b"]))) > 0.0
+
+
+def test_lora_quantized_base(rng):
+    from deepspeed_tpu.linear.optimized_linear import (LoRAConfig, OptimizedLinear,
+                                                       QuantizationConfig)
+    lin = OptimizedLinear(64, 32, lora_config=LoRAConfig(lora_r=4),
+                          quantization_config=QuantizationConfig(q_bits=8, group_size=64))
+    params = lin.init(rng)
+    x = jax.random.normal(rng, (2, 64))
+    y = lin.apply(params, x)
+    assert y.shape == (2, 32) and bool(jnp.all(jnp.isfinite(y)))
+
+
+def test_activation_checkpointing_api(rng):
+    from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ckpt
+
+    def layer(x):
+        return jnp.tanh(x @ jnp.ones((8, 8)))
+
+    x = jax.random.normal(rng, (4, 8))
+    plain = layer(x)
+    wrapped = ckpt.checkpoint(layer, x)
+    np.testing.assert_allclose(np.asarray(plain), np.asarray(wrapped), atol=1e-6)
+    g1 = jax.grad(lambda x: jnp.sum(layer(x)))(x)
+    g2 = jax.grad(lambda x: jnp.sum(ckpt.checkpoint_wrapper(layer)(x)))(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-6)
+    ckpt.configure(partition_activations=True)
+    assert ckpt.partition_activations_spec() is not None
+
+
+def test_zero_init_and_gathered_params(rng):
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model
+    with ds.zero.Init(config_dict_or_path={"zero_optimization": {"stage": 3}}):
+        model = build_model("tiny")
+    cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 1,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3}, "steps_per_print": 10 ** 9}
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    tok = engine.module_params["embed"]["tok"]
+    assert not tok.sharding.is_fully_replicated
+    with ds.zero.GatheredParameters({"tok": tok}) as full:
+        assert full["tok"].sharding.is_fully_replicated
