@@ -11,6 +11,14 @@ vs_baseline: measured MFU / 0.40 — DeepSpeed's published large-model
 training runs sustain roughly 40% MFU on A100 (e.g. Ulysses blog: >54% of
 peak on its best config, typical ZeRO-3 runs lower); beating 1.0 means the
 TPU step loop is better at feeding its matrix units than the reference's.
+
+Methodology notes (hard-won on the tunneled single-chip platform):
+- `jax.block_until_ready` is NOT a reliable sync there; every timing syncs
+  by `jax.device_get` of a value data-dependent on the step.
+- The first few executions of a fresh executable pay tunnel/load overhead,
+  so warmup runs several steps before the timed window.
+- Batches are staged on device before the timed loop (input pipeline is
+  benchmarked by the data-pipeline suite, not here).
 """
 
 import json
@@ -35,11 +43,11 @@ def main():
     # a tiny one on CPU fallback so the bench always completes.
     if platform == "tpu":
         cfg = get_config("gpt2-small", max_seq_len=1024)
-        batch, seq, steps = 8, 1024, 20
+        batch, seq, warmup, steps = 8, 1024, 5, 30
         dtype = "bfloat16"
     else:
         cfg = get_config("tiny-gpt2")
-        batch, seq, steps = 8, 128, 5
+        batch, seq, warmup, steps = 8, 128, 2, 5
         dtype = "float32"
 
     model = build_model(cfg.replace(dtype=dtype))
@@ -57,17 +65,22 @@ def main():
     rng = np.random.default_rng(0)
 
     def make_batch():
-        ids = rng.integers(0, cfg.vocab_size, (config["train_batch_size"], seq))
+        ids = rng.integers(0, cfg.vocab_size, (config["train_batch_size"], seq),
+                           dtype=np.int32)
         return {"input_ids": ids, "labels": ids}
 
-    # warmup / compile
-    engine.train_batch(make_batch())
-    jax.block_until_ready(engine.module_params)
+    # Pre-stage a few distinct batches on device (sharded the way train_batch
+    # expects them); the timed loop cycles through them.
+    batches = [engine.stage_batch(make_batch()) for _ in range(4)]
+
+    for i in range(warmup):
+        loss = engine.train_batch(batches[i % len(batches)])
+    _ = jax.device_get(loss)  # full sync: loss depends on the whole step chain
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss = engine.train_batch(make_batch())
-    jax.block_until_ready(loss)
+    for i in range(steps):
+        loss = engine.train_batch(batches[i % len(batches)])
+    final_loss = float(jax.device_get(loss))
     dt = time.perf_counter() - t0
 
     tokens = steps * config["train_batch_size"] * seq
@@ -94,7 +107,8 @@ def main():
             "params_m": round(n_params / 1e6, 1),
             "achieved_tflops_per_chip": round(achieved_tflops, 2),
             "mfu": round(mfu, 4),
-            "final_loss": round(float(loss), 4),
+            "step_ms": round(dt / steps * 1e3, 1),
+            "final_loss": round(final_loss, 4),
         },
     }
     print(json.dumps(result))

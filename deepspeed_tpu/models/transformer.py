@@ -205,8 +205,12 @@ class CausalLM:
                                  return_aux_loss=True)
         labels = batch["labels"]
         logits = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+        # nll = logsumexp(logits) - logits[label]: avoids materializing the
+        # full (B, S, V) log-softmax in fp32 (only the (B, S) reductions and
+        # the gathered label logits leave the fusion).
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = lse - label_logits
         mask = batch.get("loss_mask")
         if mask is None:
             loss = jnp.mean(nll)

@@ -21,6 +21,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils import groups
 
+_FALLBACK_WARNED = set()
+
 
 def _use_pallas() -> bool:
     import os
@@ -91,7 +93,21 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         try:
             from .pallas.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
-        except Exception:
+        except Exception as e:
+            # A silent fallback here would quietly cost O(S^2) memory and a
+            # large fraction of peak throughput — warn loudly, once per shape.
+            global _FALLBACK_WARNED
+            key = (q.shape, str(q.dtype))
+            if key not in _FALLBACK_WARNED:
+                _FALLBACK_WARNED.add(key)
+                import logging
+                logging.getLogger("DeepSpeedTPU").warning(
+                    "Pallas flash attention FAILED for shape %s (%s: %s); "
+                    "falling back to O(S^2) XLA attention. Performance will "
+                    "suffer — set DS_TPU_DISABLE_PALLAS=1 to silence.",
+                    q.shape, type(e).__name__, e)
+            if impl == "flash":
+                raise
             out = reference_attention(q, k, v, causal=causal, bias=bias,
                                       segment_ids=segment_ids, scale=scale)
     else:

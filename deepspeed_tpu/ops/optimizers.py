@@ -28,10 +28,18 @@ def _f32(t):
 
 
 class Optimizer:
-    """Base: subclasses define _init_slot(p) and _update_one(g, p, slots, ctx)."""
+    """Base: subclasses define _init_slot(p) and _update_one(g, p, slots, ctx).
+
+    When ``master_weights`` is True (set by the engine for bf16/fp16
+    training), each low-precision param carries an fp32 master copy in its
+    slot dict (reference ``runtime/bf16_optimizer.py:34``): the update reads
+    and writes the master, and the low-precision param is derived by cast —
+    small updates are never lost to the low-precision round-trip.
+    """
 
     name = "base"
     defaults: Dict[str, Any] = {}
+    master_weights = False
 
     def __init__(self, **hyper):
         unknown = set(hyper) - set(self.defaults)
@@ -39,9 +47,20 @@ class Optimizer:
             raise TypeError(f"{type(self).__name__} got unknown hyperparameters {sorted(unknown)}")
         self.hyper = {**self.defaults, **hyper}
 
+    def _needs_master(self, p):
+        return self.master_weights and p.dtype != jnp.float32
+
     def init(self, params):
+        flat_p, treedef = jax.tree.flatten(params)
+        slots = []
+        for p in flat_p:
+            s = self._init_slot(p)
+            if self._needs_master(p):
+                s = dict(s)
+                s["master"] = p.astype(jnp.float32)
+            slots.append(s)
         return {"step": jnp.zeros((), jnp.int32),
-                "slots": jax.tree.map(self._init_slot, params)}
+                "slots": jax.tree.unflatten(treedef, slots)}
 
     def apply(self, grads, state, params, lr: Optional[jnp.ndarray] = None):
         step = state["step"] + 1
@@ -55,7 +74,11 @@ class Optimizer:
         flat_s = treedef.flatten_up_to(state["slots"])
         new_p, new_s = [], []
         for p, g, s in zip(flat_p, flat_g, flat_s):
-            np_, ns_ = self._update_one(g.astype(jnp.float32), p, s, ctx)
+            p_eff = s["master"] if "master" in s else p
+            np_, ns_ = self._update_one(g.astype(jnp.float32), p_eff, s, ctx)
+            if "master" in s:
+                ns_ = dict(ns_)
+                ns_["master"] = np_
             new_p.append(np_.astype(p.dtype))
             new_s.append(ns_)
         return (jax.tree.unflatten(treedef, new_p),

@@ -31,10 +31,11 @@ _CONFIG = {
     "num_checkpoints": None,
     "synchronize": False,
     "profile": False,
-    "policy": "nothing",
+    "policy": "none",
 }
 
 POLICIES = {
+    "none": None,     # remat disabled entirely
     "nothing": None,  # save nothing → full recompute
     "dots": "checkpoint_dots",
     "dots_no_batch": "checkpoint_dots_with_no_batch_dims",

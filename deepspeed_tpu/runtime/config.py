@@ -40,6 +40,10 @@ class DeepSpeedFP16Config(DeepSpeedConfigModel):
 class DeepSpeedBF16Config(DeepSpeedConfigModel):
     enabled: bool = False
     immediate_grad_update: bool = False
+    # Keep fp32 master copies of bf16 params in the optimizer state
+    # (reference BF16_Optimizer, runtime/bf16_optimizer.py:34). Without them
+    # every update round-trips through bf16 and small updates are lost.
+    master_weights: bool = True
 
 
 class DeepSpeedOptimizerConfig(DeepSpeedConfigModel):
@@ -96,8 +100,11 @@ class ActivationCheckpointingConfig(DeepSpeedConfigModel):
     number_checkpoints: Optional[int] = None
     synchronize_checkpoint_boundary: bool = False
     profile: bool = False
-    # TPU-native: jax.checkpoint policy name ("nothing", "dots", "dots_with_no_batch_dims", "everything")
-    policy: str = "nothing"
+    # TPU-native: jax.checkpoint policy name ("none" = no remat, "nothing" =
+    # save nothing/full recompute, "dots", "dots_with_no_batch_dims",
+    # "everything"). Off by default, matching the reference (activation
+    # checkpointing only when the model/config asks for it).
+    policy: str = "none"
 
 
 class MonitorConfigBlock(DeepSpeedConfigModel):

@@ -179,6 +179,19 @@ class DeepSpeedEngine:
                 self.model.cfg = self.model.cfg.replace(remat=ac.policy)
 
     def _configure_optimizer(self, client_optimizer) -> Optimizer:
+        opt = self._build_base_optimizer(client_optimizer)
+        # fp32 master weights for low-precision training (reference
+        # BF16_Optimizer / FP16_Optimizer keep hp params;
+        # runtime/bf16_optimizer.py:34). fp16_master_weights_and_grads
+        # opts out for fp16 (reference stage_1_and_2.py fp16 master mode).
+        dt = self._config.precision_dtype
+        if dt == jnp.bfloat16:
+            opt.master_weights = self._config.bf16.master_weights
+        elif dt == jnp.float16:
+            opt.master_weights = not self._config.fp16.fp16_master_weights_and_grads
+        return opt
+
+    def _build_base_optimizer(self, client_optimizer) -> Optimizer:
         if isinstance(client_optimizer, Optimizer):
             log_dist("Using client Optimizer instance", ranks=[0])
             return client_optimizer
@@ -451,7 +464,7 @@ class DeepSpeedEngine:
             return  # not at boundary yet (reference skips inside backward loop)
         assert self._acc_grads is not None, "step() without accumulated gradients"
         self.timers(STEP_GLOBAL_TIMER).start()
-        lr = jnp.float32(self._next_lr())
+        lr = self._next_lr_device()
         self._swap_in_opt_state()
         (self.module_params, self.opt_state, self.scaler_state, overflow,
          grad_norm) = self._update_fn(self.module_params, self.opt_state, self.scaler_state,
@@ -464,31 +477,43 @@ class DeepSpeedEngine:
         self._post_step(overflow, grad_norm)
         self.timers(STEP_GLOBAL_TIMER).stop()
 
+    def _stage_leaf(self, x):
+        """Reshape one batch leaf to (gas, global_micro, ...) and device-put
+        it with batch-dim sharding. Already-staged ``jax.Array`` leaves with
+        the right layout pass through without a copy."""
+        gas = self.gradient_accumulation_steps()
+        mb = self.train_micro_batch_size_per_gpu()
+        arr = x if isinstance(x, jax.Array) else jnp.asarray(x)
+        if arr.ndim >= 1 and arr.shape[0] == gas * mb * self.dp_world_size:
+            arr = arr.reshape((gas, mb * self.dp_world_size) + arr.shape[1:])
+        elif arr.ndim >= 2 and arr.shape[0] == gas:
+            pass
+        else:
+            raise ValueError(
+                f"train_batch leaf has leading dim {arr.shape[0]}; expected "
+                f"gas*global_micro={gas * mb * self.dp_world_size} or (gas, ...) layout")
+        spec = shd.batch_spec(self.mesh)
+        nd_spec = P(None, *list(spec)[:arr.ndim - 1])
+        return jax.device_put(arr, NamedSharding(self.mesh, nd_spec))
+
+    def stage_batch(self, batch):
+        """Pre-stage a host batch on device in ``train_batch`` layout.
+
+        Staged batches make the train loop fully async: ``train_batch``
+        recognises them and skips host→device transfer (the analog of the
+        reference's pinned-buffer ``_exec_load_micro_batch``,
+        ``runtime/pipe/engine.py:882``)."""
+        return jax.tree.map(self._stage_leaf, batch)
+
     def train_batch(self, batch):
         """Fused fast path: one compiled step for a full global batch.
 
         ``batch`` leaves: (gas * micro_bs, ...) or (gas, micro_bs, ...).
         """
         gas = self.gradient_accumulation_steps()
-        mb = self.train_micro_batch_size_per_gpu()
-
-        def reshape(x):
-            arr = jnp.asarray(x)
-            if arr.ndim >= 1 and arr.shape[0] == gas * mb * self.dp_world_size:
-                arr = arr.reshape((gas, mb * self.dp_world_size) + arr.shape[1:])
-            elif arr.ndim >= 2 and arr.shape[0] == gas:
-                pass
-            else:
-                raise ValueError(
-                    f"train_batch leaf has leading dim {arr.shape[0]}; expected "
-                    f"gas*global_micro={gas * mb * self.dp_world_size} or (gas, ...) layout")
-            spec = shd.batch_spec(self.mesh)
-            nd_spec = P(None, *list(spec)[:arr.ndim - 1])
-            return jax.device_put(arr, NamedSharding(self.mesh, nd_spec))
-
-        batch = jax.tree.map(reshape, batch)
+        batch = jax.tree.map(self._stage_leaf, batch)
         self.tput_timer.start()
-        lr = jnp.float32(self._next_lr())
+        lr = self._next_lr_device()
         self._swap_in_opt_state()
         (self.module_params, self.opt_state, self.scaler_state, loss, overflow,
          grad_norm) = self._train_step_fn(self.module_params, self.opt_state,
@@ -521,6 +546,16 @@ class DeepSpeedEngine:
             self.lr_scheduler.step()
             return self.lr_scheduler.get_lr()[0]
         return self.optimizer.hyper.get("lr", 1e-3)
+
+    def _next_lr_device(self):
+        """Device scalar for the next step's lr, cached while unchanged
+        (a fresh host→device scalar transfer every step is measurable
+        latency on remote/tunneled platforms)."""
+        lr = float(self._next_lr())
+        cached = getattr(self, "_lr_cache", None)
+        if cached is None or cached[0] != lr:
+            self._lr_cache = (lr, jnp.float32(lr))
+        return self._lr_cache[1]
 
     def _post_step(self, overflow, grad_norm):
         if self.monitor is not None and getattr(self.monitor, "enabled", False) and \

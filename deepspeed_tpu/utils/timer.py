@@ -140,7 +140,10 @@ class ThroughputTimer:
         self._init_timer()
         self.started = True
         if self.global_step_count >= self.start_step:
-            _device_fence()
+            # No device fence here: XLA dispatch is async and a per-step
+            # fence would serialize host and device (very costly on remote
+            # platforms). Over a window of steps the steady-state wall time
+            # between start/stop pairs converges to true step time.
             self.start_time = time.perf_counter()
 
     def stop(self, global_step=False, report_speed=True):
@@ -151,7 +154,6 @@ class ThroughputTimer:
         if global_step:
             self.global_step_count += 1
         if self.start_time > 0:
-            _device_fence()
             self.end_time = time.perf_counter()
             duration = self.end_time - self.start_time
             self.total_elapsed_time += duration

@@ -51,7 +51,19 @@ def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str, tag=None) -> D
         state = ckptr.restore(os.path.abspath(path))
         module = state["module"]
     flat = _flatten(module)
-    return {k: np.asarray(v, dtype=np.float32) for k, v in flat.items()}
+    out = {k: np.asarray(v, dtype=np.float32) for k, v in flat.items()}
+    # Prefer the optimizer's fp32 master weights when present (reference
+    # zero_to_fp32 reconstructs fp32 from the ZeRO optimizer shards, not the
+    # low-precision model weights).
+    opt = state.get("optimizer") if isinstance(state, dict) else None
+    if opt and isinstance(opt, dict) and "slots" in opt:
+        masters = {k[:-len(".master")]: v
+                   for k, v in _flatten(opt["slots"]).items()
+                   if k.endswith(".master")}
+        for k, v in masters.items():
+            if k in out:
+                out[k] = np.asarray(v, dtype=np.float32)
+    return out
 
 
 def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str, output_file: str,
