@@ -46,8 +46,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
 
     def body(j, carry):
         m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]                       # (Bk, D)
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        k = k_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]                       # (Bk, D)
+        v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale   # (Bq, Bk)
         if causal:
@@ -119,8 +119,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         kv_hi = num_kv
 
     def body(j, dq):
-        k = k_ref[0, 0, pl.ds(j * block_k, block_k), :]
-        v = v_ref[0, 0, pl.ds(j * block_k, block_k), :]
+        k = k_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
+        v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -150,10 +150,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
 
     def body(i, carry):
         dk, dv = carry
-        q = q_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        do = do_ref[0, 0, pl.ds(i * block_q, block_q), :]
-        lse = lse_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
-        delta = delta_ref[0, 0, 0, pl.ds(i * block_q, block_q)]
+        q = q_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q), :]
+        do = do_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q), :]
+        lse = lse_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
+        delta = delta_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
         if causal:
