@@ -44,6 +44,12 @@ class TransformerConfig:
     attn_impl: str = "auto"
     # remat policy for scan-over-layers ("none"|"full"|"dots")
     remat: str = "none"
+    # vocab-chunked fused cross-entropy (ops/cross_entropy.py): number of
+    # lm-head chunks; 0 disables. Engaged when the (B, S, V) logits would
+    # exceed loss_chunk_threshold_bytes — the fused path trades one extra
+    # lm-head matmul for never materializing the logits.
+    loss_chunks: int = 8
+    loss_chunk_threshold_bytes: int = 1 << 30
 
     @property
     def kv_heads(self) -> int:

@@ -115,9 +115,8 @@ class CausalLM:
             mlp_out, aux = L.apply_mlp(lp["mlp"], m_in, cfg), jnp.zeros((), jnp.float32)
         return h + mlp_out, aux
 
-    def apply(self, params, input_ids, *, positions=None, segment_ids=None,
-              return_aux_loss=False):
-        """input_ids: (B, S) int32 → logits (B, S, V)."""
+    def hidden_states(self, params, input_ids, *, positions=None, segment_ids=None):
+        """Embed + layer stack + final norm: (B, S) → ((B, S, E), aux_loss)."""
         cfg = self.cfg
         dt = cfg.act_dtype
         h = params["embed"]["tok"].astype(dt)[input_ids]
@@ -137,12 +136,27 @@ class CausalLM:
         (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
                                          params["layers"])
         h = L.apply_norm(params["final_norm"], h, cfg)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
+        return h, aux_total / cfg.num_layers
+
+    def _lm_head_weight(self, params):
+        """Returns (w, transpose): logits = h @ (w.T if not transpose else w)."""
+        if self.cfg.tie_embeddings:
+            return params["embed"]["tok"], False
+        return params["embed"]["lm_head"], True
+
+    def apply(self, params, input_ids, *, positions=None, segment_ids=None,
+              return_aux_loss=False):
+        """input_ids: (B, S) int32 → logits (B, S, V)."""
+        dt = self.cfg.act_dtype
+        h, aux_total = self.hidden_states(params, input_ids, positions=positions,
+                                          segment_ids=segment_ids)
+        w, transpose = self._lm_head_weight(params)
+        if transpose:
+            logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
         else:
-            logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
+            logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
         if return_aux_loss:
-            return logits, aux_total / cfg.num_layers
+            return logits, aux_total
         return logits
 
     # -- decode (KV-cache) --
@@ -199,23 +213,39 @@ class CausalLM:
         fp16 training too); adds MoE aux loss when configured.
         """
         cfg = self.cfg
-        logits, aux = self.apply(params, batch["input_ids"],
-                                 positions=batch.get("positions"),
-                                 segment_ids=batch.get("segment_ids"),
-                                 return_aux_loss=True)
         labels = batch["labels"]
-        logits = logits.astype(jnp.float32)
-        # nll = logsumexp(logits) - logits[label]: avoids materializing the
-        # full (B, S, V) log-softmax in fp32 (only the (B, S) reductions and
-        # the gathered label logits leave the fusion).
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        nll = lse - label_logits
         mask = batch.get("loss_mask")
-        if mask is None:
-            loss = jnp.mean(nll)
+        # The fused path trades one extra lm-head matmul (bwd recompute) for
+        # never materializing (B, S, V): a win only once the logits are
+        # actually big. Shapes are static under jit, so decide here.
+        logit_bytes = (batch["input_ids"].size * cfg.vocab_size
+                       * (2 if cfg.act_dtype != jnp.float32 else 4))
+        if (cfg.loss_chunks > 0 and cfg.vocab_size >= 4096
+                and logit_bytes > cfg.loss_chunk_threshold_bytes):
+            # fused vocab-chunked path: the (B, S, V) logits never exist
+            from ..ops.cross_entropy import lm_cross_entropy
+            h, aux = self.hidden_states(params, batch["input_ids"],
+                                        positions=batch.get("positions"),
+                                        segment_ids=batch.get("segment_ids"))
+            w, transpose = self._lm_head_weight(params)
+            loss = lm_cross_entropy(h, w.astype(h.dtype), labels, loss_mask=mask,
+                                    n_chunks=cfg.loss_chunks, transpose_w=transpose)
         else:
-            loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            logits, aux = self.apply(params, batch["input_ids"],
+                                     positions=batch.get("positions"),
+                                     segment_ids=batch.get("segment_ids"),
+                                     return_aux_loss=True)
+            logits = logits.astype(jnp.float32)
+            # nll = logsumexp(logits) - logits[label]: avoids materializing
+            # the full (B, S, V) log-softmax in fp32 (only the (B, S)
+            # reductions and the gathered label logits leave the fusion).
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+            nll = lse - label_logits
+            if mask is None:
+                loss = jnp.mean(nll)
+            else:
+                loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
         if cfg.is_moe:
             loss = loss + cfg.moe_aux_loss_coef * aux
         return loss

@@ -19,7 +19,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 1024
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -33,7 +33,7 @@ def _interpret() -> bool:
 # forward
 # ----------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                      # (Bq, D) input dtype
     seq_k = k_ref.shape[2]
@@ -41,45 +41,51 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, block_q, 
     if causal:
         # last kv block that intersects rows [qi*Bq, (qi+1)*Bq)
         kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
+        # kv blocks below n_full lie strictly under the diagonal: no masking
+        n_full = (qi * block_q) // block_k
     else:
         kv_hi = num_kv
+        n_full = num_kv
 
-    def body(j, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]                       # (Bk, D)
-        v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale   # (Bq, Bk)
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=1))
-        alpha = jnp.exp(m - m_new)
-        p = jnp.exp(s - m_new[:, None])
-        l_new = l * alpha + jnp.sum(p, axis=1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return m_new, l_new, acc_new
+    def make_body(masked):
+        def body(j, carry):
+            m, l, acc = carry
+            k = k_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]                   # (Bk, D)
+            v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)           # (Bq, Bk)
+            if masked:
+                rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[:, None])
+            l_new = l * alpha + jnp.sum(p, axis=1)
+            acc_new = acc * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+        return body
 
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    m, l, acc = jax.lax.fori_loop(0, kv_hi, body, (m0, l0, acc0))
+    carry = jax.lax.fori_loop(0, n_full, make_body(False), (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(n_full, kv_hi, make_body(True), carry)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse_ref[0, 0, 0] = m + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, causal, block_q, block_k):
+def _fwd(q, k, v, causal, block_q, block_k):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grid = (b, h, sq // block_q)
     group = h // kvh
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+        functools.partial(_fwd_kernel, causal=causal,
                           block_q=block_q, block_k=block_k),
         grid=grid,
         in_specs=[
@@ -96,6 +102,8 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
             jax.ShapeDtypeStruct((b, h, 1, sq), jnp.float32),
         ],
         interpret=_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q, k, v)
     return out, lse
 
@@ -105,7 +113,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
 # ----------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, causal, block_q, block_k):
+               causal, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
@@ -115,68 +123,83 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     num_kv = seq_k // block_k
     if causal:
         kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
+        n_full = (qi * block_q) // block_k
     else:
         kv_hi = num_kv
+        n_full = num_kv
 
-    def body(j, dq):
-        k = k_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
-        v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale
-        if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])                                       # (Bq, Bk)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
-        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
-                                        preferred_element_type=jnp.float32)
+    def make_body(masked):
+        def body(j, dq):
+            k = k_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
+            v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+            if masked:
+                rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])                                   # (Bq, Bk)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(k.dtype)
+            return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                            preferred_element_type=jnp.float32)
+        return body
 
-    dq = jax.lax.fori_loop(0, kv_hi, body,
+    dq = jax.lax.fori_loop(0, n_full, make_body(False),
                            jnp.zeros((block_q, q.shape[-1]), jnp.float32))
+    dq = jax.lax.fori_loop(n_full, kv_hi, make_body(True), dq)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                scale, causal, block_q, block_k):
+                causal, block_q, block_k):
     ki = pl.program_id(2)
     k = k_ref[0, 0]                                       # (Bk, D)
     v = v_ref[0, 0]
     seq_q = q_ref.shape[2]
     num_q = seq_q // block_q
-    q_lo = (ki * block_k) // block_q if causal else 0
+    if causal:
+        q_lo = (ki * block_k) // block_q
+        # q blocks at/above i_um sit fully below the diagonal: no masking
+        i_um = ((ki + 1) * block_k - 1 + block_q - 1) // block_q
+    else:
+        q_lo = 0
+        i_um = 0
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q), :]
-        do = do_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q), :]
-        lse = lse_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
-        delta = delta_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # (Bq, Bk)
-        if causal:
-            rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(rows >= cols, s, NEG_INF)
-        p = jnp.exp(s - lse[:, None])
-        dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
-        dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                          preferred_element_type=jnp.float32)
-        return dk_new, dv_new
+    def make_body(masked):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q), :]
+            do = do_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q), :]
+            lse = lse_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
+            delta = delta_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
+            s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                    preferred_element_type=jnp.float32)          # (Bq, Bk)
+            if masked:
+                rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+                cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+                s = jnp.where(rows >= cols, s, NEG_INF)
+            p = jnp.exp(s - lse[:, None])
+            dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[:, None])).astype(q.dtype)
+            dk_new = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                              preferred_element_type=jnp.float32)
+            return dk_new, dv_new
+        return body
 
     zeros = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(q_lo, num_q, body, (zeros, zeros))
+    hi = jax.lax.min(i_um, num_q) if causal else 0
+    dk, dv = jax.lax.fori_loop(q_lo, hi, make_body(True), (zeros, zeros))
+    dk, dv = jax.lax.fori_loop(hi, num_q, make_body(False), (dk, dv))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(scale, causal, block_q, block_k, residuals, g):
+def _bwd(causal, block_q, block_k, residuals, g):
     q, k, v, out, lse = residuals
     b, h, sq, d = q.shape
     kvh = k.shape[1]
@@ -186,7 +209,7 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
                     axis=-1)[:, :, None, :]  # (B,H,1,Sq)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal,
+        functools.partial(_dq_kernel, causal=causal,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, sq // block_q),
         in_specs=[
@@ -200,11 +223,13 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q, k, v, do, lse, delta)
 
     sk = k.shape[2]
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+        functools.partial(_dkv_kernel, causal=causal,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, sk // block_k),
         in_specs=[
@@ -224,6 +249,8 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
             jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
         ],
         interpret=_interpret(),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(q, k, v, do, lse, delta)
 
     if group > 1:
@@ -238,14 +265,15 @@ def _bwd(scale, causal, block_q, block_k, residuals, g):
 # public API
 # ----------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
-    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal, block_q, block_k):
+    """Scale-free core: callers fold the softmax scale into q."""
+    out, _ = _fwd(q, k, v, causal, block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k)
     return out, (q, k, v, out, lse)
 
 
@@ -267,8 +295,11 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     if s % block_q != 0 or s % block_k != 0:
         raise ValueError(f"seq len {s} not divisible by blocks ({block_q},{block_k})")
     scale = scale if scale is not None else d ** -0.5
-    qt = q.transpose(0, 2, 1, 3)
+    # Fold the softmax scale into q outside the custom_vjp: the kernels run
+    # scale-free (one fewer VPU pass over every (Bq, Bk) score tile, fwd and
+    # bwd) and autodiff chains d(q*scale)/dq for free.
+    qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, float(scale), bool(causal), int(block_q), int(block_k))
+    out = _flash_bhsd(qt, kt, vt, bool(causal), int(block_q), int(block_k))
     return out.transpose(0, 2, 1, 3)

@@ -44,6 +44,11 @@ class DeepSpeedBF16Config(DeepSpeedConfigModel):
     # (reference BF16_Optimizer, runtime/bf16_optimizer.py:34). Without them
     # every update round-trips through bf16 and small updates are lost.
     master_weights: bool = True
+    # Opt-in inf/nan grad check that skips the optimizer step on overflow
+    # (reference BF16_Optimizer check_overflow); off by default because the
+    # is-finite reduction + full-tree selects cost real step time and bf16
+    # has fp32 dynamic range.
+    check_grad_overflow: bool = False
 
 
 class DeepSpeedOptimizerConfig(DeepSpeedConfigModel):

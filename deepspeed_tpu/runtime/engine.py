@@ -38,7 +38,8 @@ from ..utils.logging import log_dist, logger
 from ..utils.timer import (BACKWARD_GLOBAL_TIMER, FORWARD_GLOBAL_TIMER, NoopTimer,
                            STEP_GLOBAL_TIMER, SynchronizedWallClockTimer, ThroughputTimer)
 from .config import DeepSpeedConfig
-from .fp16.loss_scaler import LossScaleState, create_loss_scaler, has_overflow
+from .fp16.loss_scaler import (LossScaleState, StaticLossScaler, create_loss_scaler,
+                               has_overflow)
 from .lr_schedules import LRSchedule, build_lr_schedule
 
 MEMORY_OPT_ALLREDUCE_SIZE = 500_000_000
@@ -286,22 +287,44 @@ class DeepSpeedEngine:
             lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, self.grad_shardings)
         return loss / scale, grads
 
+    @property
+    def _needs_overflow_check(self) -> bool:
+        """fp16 training skips the step on inf/nan grads (reference
+        ``engine.py:2150-2157``); for bf16/fp32 the machinery (is-finite
+        reduction + full-tree selects, real HBM traffic each step) is
+        compiled out unless ``bf16.check_grad_overflow`` opts back in
+        (reference BF16_Optimizer check_overflow)."""
+        if self._config.precision_dtype == jnp.float16:
+            return True
+        return bool(self._config.bf16.check_grad_overflow)
+
     def _apply_update(self, params, opt_state, scaler_state, grads, lr, grad_divisor):
         """Unscale, clip, overflow-check, optimizer apply (or skip)."""
         host_offload = self.opt_state_shardings is not self._opt_device_shardings
         if host_offload:  # stage host-resident state into device memory
             opt_state = jax.device_put(opt_state, self._opt_device_shardings)
-        inv = 1.0 / (scaler_state.scale * grad_divisor)
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-        overflow = has_overflow(grads)
-        grad_norm = _global_norm(grads)
+        static_one = (isinstance(self.loss_scaler, StaticLossScaler)
+                      and self.loss_scaler.scale == 1.0
+                      and isinstance(grad_divisor, (int, float)) and grad_divisor == 1)
+        if static_one:
+            # scale and divisor are compile-time 1.0: no unscale pass at all
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        else:
+            inv = 1.0 / (scaler_state.scale * grad_divisor)
+            grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        check_overflow = self._needs_overflow_check
+        overflow = has_overflow(grads) if check_overflow else jnp.zeros((), bool)
         if self.gradient_clipping > 0.0:
+            grad_norm = _global_norm(grads)
             coef = jnp.minimum(1.0, self.gradient_clipping / (grad_norm + 1e-6))
             grads = jax.tree.map(lambda g: g * coef, grads)
+        else:
+            grad_norm = jnp.zeros((), jnp.float32)
         new_params, new_opt = self.optimizer.apply(grads, opt_state, params, lr=lr)
-        # skip the update on overflow (fp16): select old state
-        new_params = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new_params, params)
-        new_opt = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state)
+        if check_overflow:
+            # skip the update on overflow (fp16): select old state
+            new_params = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new_params, params)
+            new_opt = jax.tree.map(lambda n, o: jnp.where(overflow, o, n), new_opt, opt_state)
         new_scaler = self.loss_scaler.update(scaler_state, overflow)
         if host_offload:  # results stream back to pinned host buffers
             new_opt = jax.device_put(new_opt, self.opt_state_shardings)
@@ -340,17 +363,25 @@ class DeepSpeedEngine:
             """
             scale = scaler_state.scale
 
-            def micro(carry, mb):
-                acc, loss_sum = carry
-                loss, grads = self._loss_and_grads(params, batch=mb, scale=scale)
-                return (_tree_add(acc, grads), loss_sum + loss), None
+            if gas == 1:
+                # fast path: no accumulation buffers, grads stay in param
+                # dtype until the fp32 cast inside the update
+                mb = jax.tree.map(lambda x: x[0], batch)
+                loss_sum, acc = self._loss_and_grads(params, batch=mb, scale=scale)
+                divisor = 1
+            else:
+                def micro(carry, mb):
+                    acc, loss_sum = carry
+                    loss, grads = self._loss_and_grads(params, batch=mb, scale=scale)
+                    return (_tree_add(acc, grads), loss_sum + loss), None
 
-            acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-            acc0 = jax.tree.map(lambda g, s: jax.lax.with_sharding_constraint(g, s),
-                                acc0, self.grad_shardings)
-            (acc, loss_sum), _ = jax.lax.scan(micro, (acc0, jnp.zeros((), jnp.float32)), batch)
+                acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                acc0 = jax.tree.map(lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                                    acc0, self.grad_shardings)
+                (acc, loss_sum), _ = jax.lax.scan(micro, (acc0, jnp.zeros((), jnp.float32)), batch)
+                divisor = float(gas)
             new_params, new_opt, new_scaler, overflow, grad_norm = self._apply_update(
-                params, opt_state, scaler_state, acc, lr, jnp.float32(gas))
+                params, opt_state, scaler_state, acc, lr, divisor)
             return new_params, new_opt, new_scaler, loss_sum / gas, overflow, grad_norm
 
         self._grad_fn = grad_fn
