@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Host optimizer micro-benchmark: native C++ CPUAdam vs jnp (jit, cpu).
+
+Analog of the reference's ``tests/perf/adam_test.py``. The native kernel
+(``ops/csrc/adam/cpu_adam.cpp``, OpenMP + simd) is what ZeRO-Infinity
+streaming uses on the host (``runtime/zero/infinity.py``); this shows why.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main(n=4_000_000, iters=10):
+    from deepspeed_tpu.ops.cpu_adam_native import cpu_adam_step
+
+    rng = np.random.default_rng(0)
+    p = rng.standard_normal(n).astype(np.float32)
+    g = rng.standard_normal(n).astype(np.float32)
+    m = np.zeros(n, np.float32)
+    v = np.zeros(n, np.float32)
+    cpu_adam_step(p, g, m, v, 1, 1e-3)
+    t0 = time.perf_counter()
+    for i in range(2, iters + 2):
+        cpu_adam_step(p, g, m, v, i, 1e-3)
+    native = (time.perf_counter() - t0) / iters
+
+    import jax
+    import jax.numpy as jnp
+    jax.config.update("jax_platforms", "cpu")
+
+    @jax.jit
+    def jnp_adam(p, g, m, v, step):
+        b1, b2, eps, lr = 0.9, 0.999, 1e-8, 1e-3
+        m = b1 * m + (1 - b1) * g
+        v = b2 * v + (1 - b2) * g * g
+        mh = m / (1 - b1 ** step)
+        vh = v / (1 - b2 ** step)
+        return p - lr * mh / (jnp.sqrt(vh) + eps), m, v
+
+    pj, gj, mj, vj = map(jnp.asarray, (p, g, m, v))
+    jax.block_until_ready(jnp_adam(pj, gj, mj, vj, 1))
+    t0 = time.perf_counter()
+    for i in range(2, iters + 2):
+        out = jnp_adam(pj, gj, mj, vj, i)
+    jax.block_until_ready(out)
+    jnp_t = (time.perf_counter() - t0) / iters
+
+    print(json.dumps({
+        "metric": "cpu_adam_params_per_sec",
+        "native": round(n / native / 1e6, 1),
+        "jnp": round(n / jnp_t / 1e6, 1),
+        "unit": "Mparams/s",
+        "speedup": round(jnp_t / native, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

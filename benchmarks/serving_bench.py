@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Serving micro-benchmark: FastGen-analog decode throughput.
+
+Measures tokens/sec of the compiled multi-token decode loop (Pallas paged
+attention over in-place KV pages) at several batch sizes — the serving-side
+counterpart of bench.py's training number. Reference bar: FastGen's
+throughput claims (BASELINE.md).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench(batch, model_name="gpt2-small", prompt_len=128, new_tokens=64):
+    import jax
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceEngineConfig)
+    from deepspeed_tpu.models import build_model
+
+    platform = jax.default_backend()
+    if platform != "tpu":
+        model_name, prompt_len, new_tokens = "tiny", 16, 8
+    cfg = RaggedInferenceEngineConfig(
+        max_ragged_batch_size=max(batch, 16),
+        max_tokens_per_step=max(batch * 2, 768),
+    )
+    model = build_model(model_name)
+    eng = InferenceEngineV2(model, cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, model.cfg.vocab_size, (prompt_len,)).astype(np.int32)
+               for _ in range(batch)]
+    # warmup (compiles prefill chunks + decode loop at both step counts)
+    eng.generate(prompts, max_new_tokens=4)
+    eng.generate(prompts, max_new_tokens=new_tokens)
+    # decode throughput = marginal cost of (new_tokens - 4) extra tokens,
+    # cancelling the prefill both runs share
+    t0 = time.perf_counter()
+    eng.generate(prompts, max_new_tokens=4)
+    t1 = time.perf_counter()
+    eng.generate(prompts, max_new_tokens=new_tokens)
+    t2 = time.perf_counter()
+    dt = (t2 - t1) - (t1 - t0)
+    toks = batch * (new_tokens - 4)
+    return {"batch": batch, "decode_tok_per_sec": round(toks / dt, 1),
+            "e2e_tok_per_sec": round(batch * new_tokens / (t2 - t1), 1),
+            "prompt_len": prompt_len, "new_tokens": new_tokens,
+            "platform": platform}
+
+
+def main():
+    results = [bench(b) for b in (16, 64)]
+    print(json.dumps({"metric": "fastgen_decode_throughput", "results": results}))
+
+
+if __name__ == "__main__":
+    main()

@@ -27,6 +27,45 @@ from . import moe  # noqa: F401
 from . import module_inject  # noqa: F401
 
 
+def _resolve_zero_subgroups(ds_config):
+    """Translate MiCS / ZeRO++ hpZ config into a zrep × data mesh split.
+
+    ``mics_shard_size`` k (reference ``runtime/zero/mics.py:64``): params and
+    optimizer shard over groups of k devices, replicate across groups.
+    ``zero_hpz_partition_size`` s (reference ``groups.py:529``): params keep a
+    within-group secondary partition of size s while optimizer state shards
+    over the full data-parallel world.
+    """
+    from .utils import groups as _groups
+
+    zc = ds_config.zero_config
+    mics = zc.mics_shard_size if zc.mics_shard_size and zc.mics_shard_size > 0 else 0
+    hpz = zc.zero_hpz_partition_size if zc.zero_hpz_partition_size > 1 else 0
+    if not mics and not hpz:
+        return
+    if mics and hpz:
+        raise ValueError("mics_shard_size and zero_hpz_partition_size are mutually exclusive")
+    sub = mics or hpz
+    mc = ds_config.mesh
+    if _groups.mesh_is_initialized():
+        mesh = _groups.get_mesh()
+        if mesh.shape["data"] != sub:
+            raise ValueError(
+                f"mesh already initialized with data={mesh.shape['data']}, "
+                f"zrep={mesh.shape['zrep']} — rebuild it with data={sub} and "
+                f"zrep=dp/{sub} to use "
+                f"{'mics_shard_size' if mics else 'zero_hpz_partition_size'}={sub}")
+        return
+    import jax
+    n = len(jax.devices())
+    fixed = mc.tensor * mc.pipe * mc.seq * mc.expert
+    dp_total = mc.data if isinstance(mc.data, int) and mc.data > 0 else n // fixed
+    if dp_total % sub != 0:
+        raise ValueError(f"data-parallel world {dp_total} not divisible by subgroup size {sub}")
+    mc.data = sub
+    mc.zrep = dp_total // sub
+
+
 def initialize(args=None,
                model=None,
                optimizer=None,
@@ -61,6 +100,8 @@ def initialize(args=None,
     assert model is not None, "deepspeed_tpu.initialize requires a model"
 
     ds_config = None if config is None else DeepSpeedConfig(config)
+    if ds_config is not None:
+        _resolve_zero_subgroups(ds_config)
     init_distributed(distributed_port=distributed_port, verbose=False,
                      mesh_config=None if ds_config is None else ds_config.mesh)
     if ds_config is not None and ds_config.world_size is None:

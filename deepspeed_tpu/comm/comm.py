@@ -135,6 +135,10 @@ def init_distributed(dist_backend="xla",
     """
     global cdb, comms_logger
     if cdb is not None and cdb.initialized:
+        # comm backend persists across engines in one process; the mesh may
+        # still need (re)building from this config (e.g. a MiCS/hpZ zrep split)
+        if not groups.mesh_is_initialized():
+            groups.set_mesh(groups.build_mesh(mesh_config=mesh_config))
         return cdb
     cdb = XlaBackend()
 

@@ -204,23 +204,44 @@ class InferenceEngineV2:
 
     def generate(self, prompts: List[np.ndarray], max_new_tokens: int = 32,
                  temperature: float = 0.0, eos_token_id: Optional[int] = None):
-        """Drive put/step to completion for a batch of ragged prompts."""
+        """Batch generation: SplitFuse prefill via step(), then ONE compiled
+        multi-token decode loop (lax.scan inside a single jit — no per-token
+        host round-trip). EOS handling is host-side truncation after the
+        loop; the loop itself runs the full token budget."""
         uids = list(range(len(prompts)))
         self.put(uids, prompts)
-        done_count = {u: 0 for u in uids}
-        while True:
-            produced = self.step(temperature=temperature)
-            if not produced and all(not s.in_prefill for s in self.state.seqs.values()):
-                pass
-            for uid, tok in produced.items():
-                done_count[uid] += 1
-                seq = self.state.seqs[uid]
-                if done_count[uid] >= max_new_tokens or \
-                        (eos_token_id is not None and tok == eos_token_id):
-                    seq.done = True
-            if all(self.state.seqs[u].done for u in uids):
-                break
-        outs = [np.asarray(self.state.seqs[u].generated[:max_new_tokens]) for u in uids]
+        # --- prefill (+ first generated token) via the SplitFuse scheduler ---
+        while any(self.state.seqs[u].in_prefill for u in uids):
+            self.step(temperature=temperature)
+        remaining = max_new_tokens - 1
+        if remaining > 0:
+            seqs = [self.state.seqs[u] for u in uids]
+            for s in seqs:
+                if not self.state.ensure_capacity(s, s.seen_tokens + remaining + 1):
+                    raise RuntimeError("KV pool exhausted for compiled decode loop")
+            b = len(seqs)
+            last_ids = np.asarray([s.generated[-1] for s in seqs], np.int32)
+            lens = np.asarray([s.seen_tokens for s in seqs], np.int32)
+            tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+            for i, s in enumerate(seqs):
+                tables[i, :len(s.blocks)] = s.blocks
+            self._rng, sub = jax.random.split(self._rng)
+            toks, self.kv.k, self.kv.v = self.runner.decode_loop(
+                self.params, jnp.asarray(last_ids), jnp.asarray(lens),
+                jnp.asarray(tables), self.kv.k, self.kv.v, sub,
+                jnp.float32(temperature), steps=remaining,
+                greedy=(temperature == 0.0))
+            toks = np.asarray(toks)                      # (steps, B)
+            for i, s in enumerate(seqs):
+                s.generated.extend(int(t) for t in toks[:, i])
+                s.seen_tokens += remaining
+                s.done = True
+        outs = []
+        for u in uids:
+            g = self.state.seqs[u].generated[:max_new_tokens]
+            if eos_token_id is not None and eos_token_id in g:
+                g = g[: g.index(eos_token_id) + 1]
+            outs.append(np.asarray(g))
         self.flush(uids)
         return outs
 

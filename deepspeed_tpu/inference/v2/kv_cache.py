@@ -4,10 +4,11 @@ Analog of ``inference/v2/ragged/kv_cache.py:40`` (BlockedKVCache): KV lives
 in fixed-size blocks in a device pool; sequences hold block lists, so memory
 scales with tokens actually generated instead of max_seq_len per slot.
 
-Layout: k/v pools are (L, num_blocks, block_size, KVH, D). A sequence's
-logical cache is the concatenation of its blocks; attention gathers pages by
-block table (XLA gather; a Pallas in-place paged-attention kernel is the
-optimization path).
+Layout: k/v pools are (L, KVH, num_blocks, block_size, D) — kv-head-major so
+the Pallas paged-decode kernel (``ops/pallas/paged_attention.py``) reads each
+(page, head) slab contiguously in place. A sequence's logical cache is the
+concatenation of its blocks; prefill chunks gather pages by block table (XLA
+gather), decode attends in place.
 """
 
 from typing import List, Optional
@@ -26,7 +27,7 @@ class BlockedKVCache:
         self.head_dim = head_dim
         self.block_size = block_size
         self.num_blocks = num_blocks
-        shape = (num_layers, num_blocks, block_size, kv_heads, head_dim)
+        shape = (num_layers, kv_heads, num_blocks, block_size, head_dim)
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
         self.allocator = BlockedAllocator(num_blocks)
@@ -48,14 +49,16 @@ class BlockedKVCache:
         pos = start_pos + jnp.arange(s)
         blk = block_ids[pos // self.block_size]       # (S,) physical block
         off = pos % self.block_size                    # (S,) offset in block
-        self.k = self.k.at[:, blk, off].set(new_k)
-        self.v = self.v.at[:, blk, off].set(new_v)
+        self.k = self.k.at[:, :, blk, off].set(new_k.transpose(0, 2, 1, 3))
+        self.v = self.v.at[:, :, blk, off].set(new_v.transpose(0, 2, 1, 3))
 
     def gather(self, block_table: jnp.ndarray):
         """block_table: (B, max_blocks) → (L, B, max_blocks*block_size, KVH, D)
         contiguous logical view (padding blocks read block 0 — callers mask
         by sequence length)."""
-        k = jnp.take(self.k, block_table, axis=1)      # (L, B, max_blocks, bs, KVH, D)
-        v = jnp.take(self.v, block_table, axis=1)
-        l, b, nb, bs, kvh, d = k.shape
-        return (k.reshape(l, b, nb * bs, kvh, d), v.reshape(l, b, nb * bs, kvh, d))
+        k = jnp.take(self.k, block_table, axis=2)      # (L, KVH, B, max_blocks, bs, D)
+        v = jnp.take(self.v, block_table, axis=2)
+        l, kvh, b, nb, bs, d = k.shape
+        k = k.reshape(l, kvh, b, nb * bs, d).transpose(0, 2, 3, 1, 4)
+        v = v.reshape(l, kvh, b, nb * bs, d).transpose(0, 2, 3, 1, 4)
+        return (k, v)

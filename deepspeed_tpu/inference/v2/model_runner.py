@@ -7,12 +7,16 @@ chunks — prefill chunks (C>1) and decode steps (C=1) are the same program at
 different chunk widths, which is the Dynamic-SplitFuse unification.
 
 Per layer, inside a ``lax.scan`` over the stacked params zipped with the KV
-pools' layer slices: project q/k/v, RoPE at absolute positions, scatter the
-chunk's KV into its pages, gather the sequence's pages, attend with per-query
-causal masking. Pools are donated, so XLA updates pages in place.
+pools' layer slices ((KVH, NB, bs, D) — kv-head-major): project q/k/v, RoPE
+at absolute positions, scatter the chunk's KV into its pages, then attend.
+Decode steps (C=1) use the Pallas paged kernel
+(``ops/pallas/paged_attention.py``) which reads pages IN PLACE via the block
+table; prefill chunks gather pages (the gather amortizes over the chunk's
+matmuls). Pools are donated, so XLA updates pages in place.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -20,6 +24,12 @@ import jax.numpy as jnp
 from ...models import layers as L
 from ...models.transformer import CausalLM
 from ...ops.attention import decode_attention
+
+
+def _use_pallas_paged() -> bool:
+    if os.environ.get("DS_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    return jax.default_backend() == "tpu"
 
 
 class PagedModelRunner:
@@ -31,75 +41,130 @@ class PagedModelRunner:
         self._fns = {}
 
     def _build(self, chunk: int):
-        cfg = self.cfg
-        bs = self.block_size
-        model = self.model
+        fwd = self._forward
 
         @functools.partial(jax.jit, donate_argnums=(5, 6))
         def run(params, ids, positions, block_tables, valid_counts, kpool, vpool):
-            """ids/positions: (B, C); block_tables: (B, MB);
-            valid_counts: (B,) number of real (non-pad) tokens in the chunk;
-            kpool/vpool: (L, NB, bs, KVH, D). Returns (last_logits (B, V),
-            kpool, vpool)."""
-            dt = cfg.act_dtype
-            b, c = ids.shape
-            h = params["embed"]["tok"].astype(dt)[ids]
-            if cfg.position == "learned":
-                h = h + params["embed"]["pos"].astype(dt)[jnp.clip(positions, 0,
-                                                                   cfg.max_seq_len - 1)]
-            inv_freq = model._inv_freq
-            b_idx = jnp.arange(b)[:, None]                      # (B, 1)
-            # positions < 0 mark padding: route their writes to trash block 0
-            is_pad = positions < 0
-            pos_safe = jnp.maximum(positions, 0)
-            blk = jnp.where(is_pad, 0, jnp.take_along_axis(
-                block_tables, pos_safe // bs, axis=1))          # (B, C)
-            off = pos_safe % bs
-            seq_lens_after = jnp.max(jnp.where(is_pad, 0, positions + 1), axis=1)
-
-            def layer(h, xs):
-                lp, kp, vp = xs
-                a_in = L.apply_norm(lp["norm1"], h, cfg)
-                q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
-                k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
-                v = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wv"].astype(dt))
-                if cfg.use_bias:
-                    q = q + lp["attn"]["bq"].astype(dt)
-                    k = k + lp["attn"]["bk"].astype(dt)
-                    v = v + lp["attn"]["bv"].astype(dt)
-                if cfg.position == "rope":
-                    q = L.apply_rope(q, pos_safe, inv_freq)
-                    k = L.apply_rope(k, pos_safe, inv_freq)
-                kp = kp.at[blk, off].set(k.astype(kp.dtype))
-                vp = vp.at[blk, off].set(v.astype(vp.dtype))
-                kpages = kp[block_tables].reshape(b, -1, cfg.kv_heads, cfg.dims_per_head)
-                vpages = vp[block_tables].reshape(b, -1, cfg.kv_heads, cfg.dims_per_head)
-                # per-query causal mask via positions: query at position p sees
-                # cache slots [0, p]; decode_attention masks by slot index.
-                out = _paged_attention(q, kpages, vpages, positions, cfg)
-                y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
-                if cfg.use_bias:
-                    y = y + lp["attn"]["bo"].astype(dt)
-                h2 = h + y
-                m_in = L.apply_norm(lp["norm2"], h2, cfg)
-                if cfg.is_moe:
-                    mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
-                else:
-                    mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
-                return h2 + mlp_out, (kp, vp)
-
-            h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool))
-            h = L.apply_norm(params["final_norm"], h, cfg)
-            # last valid token of each chunk
-            last_idx = jnp.maximum(valid_counts - 1, 0)
-            h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
-            if cfg.tie_embeddings:
-                logits = jnp.einsum("be,ve->bv", h_last, params["embed"]["tok"].astype(dt))
-            else:
-                logits = jnp.einsum("be,ev->bv", h_last, params["embed"]["lm_head"].astype(dt))
-            return logits.astype(jnp.float32), kpool, vpool
+            return fwd(params, ids, positions, block_tables, valid_counts, kpool, vpool)
 
         return run
+
+    def _forward(self, params, ids, positions, block_tables, valid_counts, kpool, vpool):
+        """ids/positions: (B, C); block_tables: (B, MB);
+        valid_counts: (B,) number of real (non-pad) tokens in the chunk;
+        kpool/vpool: (L, KVH, NB, bs, D). Returns (last_logits (B, V),
+        kpool, vpool)."""
+        cfg = self.cfg
+        bs = self.block_size
+        model = self.model
+        dt = cfg.act_dtype
+        b, c = ids.shape
+        h = params["embed"]["tok"].astype(dt)[ids]
+        if cfg.position == "learned":
+            h = h + params["embed"]["pos"].astype(dt)[jnp.clip(positions, 0,
+                                                               cfg.max_seq_len - 1)]
+        inv_freq = model._inv_freq
+        b_idx = jnp.arange(b)[:, None]                      # (B, 1)
+        # positions < 0 mark padding: route their writes to trash block 0
+        is_pad = positions < 0
+        pos_safe = jnp.maximum(positions, 0)
+        blk = jnp.where(is_pad, 0, jnp.take_along_axis(
+            block_tables, pos_safe // bs, axis=1))          # (B, C)
+        off = pos_safe % bs
+        seq_lens_after = jnp.max(jnp.where(is_pad, 0, positions + 1), axis=1)
+
+        def layer(h, xs):
+            lp, kp, vp = xs
+            a_in = L.apply_norm(lp["norm1"], h, cfg)
+            q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
+            k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
+            v = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wv"].astype(dt))
+            if cfg.use_bias:
+                q = q + lp["attn"]["bq"].astype(dt)
+                k = k + lp["attn"]["bk"].astype(dt)
+                v = v + lp["attn"]["bv"].astype(dt)
+            if cfg.position == "rope":
+                q = L.apply_rope(q, pos_safe, inv_freq)
+                k = L.apply_rope(k, pos_safe, inv_freq)
+            kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
+            vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
+            if c == 1 and _use_pallas_paged():
+                # decode: Pallas kernel reads pages in place (no gather)
+                from ...ops.pallas.paged_attention import paged_decode_attention
+                out = paged_decode_attention(
+                    q[:, 0], kp, vp, block_tables,
+                    seq_lens=jnp.maximum(positions[:, 0] + 1, 0))[:, None]
+            else:
+                kpages = kp[:, block_tables].reshape(
+                    cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
+                vpages = vp[:, block_tables].reshape(
+                    cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
+                # per-query causal mask via positions: query at position p
+                # sees cache slots [0, p]; masks by slot index.
+                out = _paged_attention(q, kpages, vpages, positions, cfg)
+            y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
+            if cfg.use_bias:
+                y = y + lp["attn"]["bo"].astype(dt)
+            h2 = h + y
+            m_in = L.apply_norm(lp["norm2"], h2, cfg)
+            if cfg.is_moe:
+                mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
+            else:
+                mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+            return h2 + mlp_out, (kp, vp)
+
+        h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool))
+        h = L.apply_norm(params["final_norm"], h, cfg)
+        # last valid token of each chunk
+        last_idx = jnp.maximum(valid_counts - 1, 0)
+        h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+        if cfg.tie_embeddings:
+            logits = jnp.einsum("be,ve->bv", h_last, params["embed"]["tok"].astype(dt))
+        else:
+            logits = jnp.einsum("be,ev->bv", h_last, params["embed"]["lm_head"].astype(dt))
+        return logits.astype(jnp.float32), kpool, vpool
+
+    def _build_decode_loop(self):
+        fwd = self._forward
+
+        @functools.partial(jax.jit, donate_argnums=(4, 5),
+                           static_argnames=("steps", "greedy"))
+        def loop(params, last_ids, seq_lens, block_tables, kpool, vpool, rng,
+                 temperature, steps, greedy):
+            """Compiled multi-token decode (reference serves one jit + host
+            sync per token, ``engine_v2.py:158``; this is the lax.scan path
+            VERDICT's blocked-flash row asks for): `steps` greedy/sampled
+            tokens per sequence with NO host round-trips in between.
+
+            last_ids: (B,) previous token; seq_lens: (B,) tokens already in
+            cache. Block tables must already cover seq_lens + steps slots.
+            Returns (tokens (steps, B), kpool, vpool)."""
+            b = last_ids.shape[0]
+            ones = jnp.ones((b,), jnp.int32)
+
+            def body(carry, _):
+                ids, lens, rng, kpool, vpool = carry
+                logits, kpool, vpool = fwd(params, ids[:, None], lens[:, None],
+                                           block_tables, ones, kpool, vpool)
+                if greedy:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    rng, sub = jax.random.split(rng)
+                    nxt = jax.random.categorical(
+                        sub, logits / jnp.maximum(temperature, 1e-6), axis=-1
+                    ).astype(jnp.int32)
+                return (nxt, lens + 1, rng, kpool, vpool), nxt
+
+            (_, _, _, kpool, vpool), toks = jax.lax.scan(
+                body, (last_ids, seq_lens, rng, kpool, vpool), None, length=steps)
+            return toks, kpool, vpool
+
+        return loop
+
+    def decode_loop(self, *args, **kwargs):
+        if "loop" not in self._fns:
+            self._fns["loop"] = self._build_decode_loop()
+        return self._fns["loop"](*args, **kwargs)
 
     def run(self, chunk: int, *args):
         if chunk not in self._fns:

@@ -218,18 +218,42 @@ def apply_moe_mlp(params, x, cfg: TransformerConfig):
     from ..moe.sharded_moe import topk_gating_einsum
     dt = cfg.act_dtype
     b, s, e = x.shape
-    tokens = x.reshape(b * s, e)
+
+    # Explicit dispatch/combine layouts (the reference's all-to-all
+    # semantics, sharded_moe.py:533 _AllToAll): tokens ride the batch axes,
+    # expert buffers ride the expert axis. Without these anchors XLA's
+    # propagation can demand embed-sharded activations inside the layer scan
+    # (involuntary full rematerialization).
+    constrain_tok = lambda t: t
+    constrain_exp = lambda t: t
+    from ..utils import groups as _groups
+    from ..parallel.sharding import current_manual_axes
+    if _groups.mesh_is_initialized() and not current_manual_axes():
+        mesh = _groups.get_mesh()
+        if mesh.devices.size > 1:
+            import jax.sharding as _js
+            batch_axes = tuple(a for a in _groups.BATCH_AXES
+                               if mesh.shape.get(a, 1) > 1) or None
+            exp_axis = "expert" if mesh.shape.get("expert", 1) > 1 else None
+            tok_sh = _js.NamedSharding(mesh, _js.PartitionSpec(batch_axes, None))
+            exp_sh = _js.NamedSharding(
+                mesh, _js.PartitionSpec(exp_axis, None, None))
+            constrain_tok = lambda t: jax.lax.with_sharding_constraint(t, tok_sh)
+            constrain_exp = lambda t: jax.lax.with_sharding_constraint(t, exp_sh)
+
+    tokens = constrain_tok(x.reshape(b * s, e))
     logits = jnp.einsum("te,ex->tx", tokens.astype(jnp.float32),
                         params["router"].astype(jnp.float32))
     combine, dispatch, aux_loss = topk_gating_einsum(
         logits, k=cfg.num_experts_per_tok, capacity_factor=cfg.moe_capacity_factor)
-    # dispatch: (T, X, C) bool → expert inputs (X, C, E)
-    expert_in = jnp.einsum("txc,te->xce", dispatch.astype(dt), tokens)
+    # dispatch: (T, X, C) bool → expert inputs (X, C, E); the einsum against
+    # batch-sharded tokens with expert-sharded output IS the all-to-all
+    expert_in = constrain_exp(jnp.einsum("txc,te->xce", dispatch.astype(dt), tokens))
     g = jnp.einsum("xce,xef->xcf", expert_in, params["wi_gate"].astype(dt))
     u = jnp.einsum("xce,xef->xcf", expert_in, params["wi_up"].astype(dt))
     h = jax.nn.silu(g) * u
-    expert_out = jnp.einsum("xcf,xfe->xce", h, params["wo"].astype(dt))
-    out = jnp.einsum("txc,xce->te", combine.astype(dt), expert_out)
+    expert_out = constrain_exp(jnp.einsum("xcf,xfe->xce", h, params["wo"].astype(dt)))
+    out = constrain_tok(jnp.einsum("txc,xce->te", combine.astype(dt), expert_out))
     return out.reshape(b, s, e), aux_loss
 
 

@@ -1,0 +1,100 @@
+"""Residual MLP stack — second pipeline-capable native model family.
+
+Counterpart of the reference's non-transformer test models
+(``tests/unit/simple_model.py`` SimpleModel: a stack of linear layers used
+to exercise engine/pipeline logic independently of attention). Implements
+the same model protocol as ``CausalLM`` (``init`` / ``abstract_params`` /
+``logical_axes`` / ``loss``) plus the pipeline three-segment protocol
+(``pipe_embed`` / ``pipe_layer`` / ``pipe_loss``) consumed by
+``runtime/pipe/engine.py build_pipeline_1f1b``, proving the compiled 1F1B
+engine is model-generic (the reference PipelineModule accepts any
+LayerSpec sequence, ``runtime/pipe/module.py:86``).
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class MLPConfig:
+    in_features: int = 32
+    hidden_size: int = 64
+    num_layers: int = 2
+    num_classes: int = 8
+    act_dtype: type = jnp.float32
+
+
+class ResidualMLP:
+    """in → Linear → [num_layers × residual (Linear, gelu, Linear)] → head.
+
+    params = {"embed": {"win": ..., "bin": ...},
+              "layers": stacked {"w1","b1","w2","b2"},
+              "head": {"wout": ..., "bout": ...}}
+    """
+
+    def __init__(self, cfg: MLPConfig):
+        self.cfg = cfg
+
+    def init(self, rng):
+        cfg = self.cfg
+        r_in, r_layers, r_out = jax.random.split(rng, 3)
+        scale_in = 1.0 / jnp.sqrt(cfg.in_features)
+        embed = {"win": jax.random.normal(r_in, (cfg.in_features, cfg.hidden_size)) * scale_in,
+                 "bin": jnp.zeros((cfg.hidden_size,))}
+        scale_h = 1.0 / jnp.sqrt(cfg.hidden_size)
+
+        def one_layer(r):
+            r1, r2 = jax.random.split(r)
+            return {"w1": jax.random.normal(r1, (cfg.hidden_size, cfg.hidden_size)) * scale_h,
+                    "b1": jnp.zeros((cfg.hidden_size,)),
+                    "w2": jax.random.normal(r2, (cfg.hidden_size, cfg.hidden_size)) * scale_h,
+                    "b2": jnp.zeros((cfg.hidden_size,))}
+
+        per_layer = [one_layer(r) for r in jax.random.split(r_layers, cfg.num_layers)]
+        layers = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+        head = {"wout": jax.random.normal(r_out, (cfg.hidden_size, cfg.num_classes)) * scale_h,
+                "bout": jnp.zeros((cfg.num_classes,))}
+        return {"embed": embed, "layers": layers, "head": head}
+
+    def abstract_params(self):
+        return jax.eval_shape(lambda: self.init(jax.random.PRNGKey(0)))
+
+    def logical_axes(self):
+        return {
+            "embed": {"win": (None, "mlp"), "bin": ("mlp",)},
+            "layers": {"w1": ("layers", None, "mlp"), "b1": ("layers", "mlp"),
+                       "w2": ("layers", "mlp", None), "b2": ("layers", "mlp")},
+            "head": {"wout": (None, None), "bout": (None,)},
+        }
+
+    # -- pipeline three-segment protocol --
+
+    def pipe_embed(self, other, batch_mb):
+        x = batch_mb["x"].astype(self.cfg.act_dtype)
+        return x @ other["embed"]["win"].astype(x.dtype) + other["embed"]["bin"].astype(x.dtype)
+
+    def pipe_layer(self, lp, h):
+        y = jax.nn.gelu(h @ lp["w1"].astype(h.dtype) + lp["b1"].astype(h.dtype))
+        y = y @ lp["w2"].astype(h.dtype) + lp["b2"].astype(h.dtype)
+        return h + y
+
+    def pipe_loss(self, other, h, batch_mb):
+        logits = (h @ other["head"]["wout"].astype(h.dtype)
+                  + other["head"]["bout"].astype(h.dtype)).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        labels = batch_mb["y"]
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+
+    # -- plain (non-pipelined) loss for parity tests --
+
+    def loss(self, params, batch):
+        other = {k: v for k, v in params.items() if k != "layers"}
+        h = self.pipe_embed(other, batch)
+
+        def one(hh, lp):
+            return self.pipe_layer(lp, hh), None
+
+        h, _ = jax.lax.scan(one, h, params["layers"])
+        return self.pipe_loss(other, h, batch)

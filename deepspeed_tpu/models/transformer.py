@@ -43,6 +43,36 @@ def _axes_of(init_fn):
     return box[0]
 
 
+def _activation_constraint():
+    """Pin the (B, S, E) scan-carried activation to batch/seq sharding.
+
+    Without this, XLA's sharding propagation can derive an embed-dim
+    sharding for the loop carry from ZeRO gradient constraints and emit an
+    'involuntary full rematerialization' reshard inside the layer scan."""
+    from ..utils import groups
+    if not groups.mesh_is_initialized():
+        return lambda h: h
+    mesh = groups.get_mesh()
+    if mesh.devices.size == 1:
+        return lambda h: h
+    from ..parallel import sharding as shd
+    from jax.sharding import NamedSharding
+    spec = shd.batch_spec(mesh)
+
+    sharding = NamedSharding(mesh, spec)
+
+    def constrain(h):
+        # decided at trace time: inside shard_map manual regions (ZeRO++
+        # quantized-collective step) sharding constraints on values varying
+        # over manual axes are invalid — the anchor is only needed for the
+        # plain-SPMD propagation anyway
+        if shd.current_manual_axes():
+            return h
+        return jax.lax.with_sharding_constraint(h, sharding)
+
+    return constrain
+
+
 def _remat_policy(name: str):
     if name == "full":
         return None  # jax.checkpoint default: save nothing
@@ -115,20 +145,61 @@ class CausalLM:
             mlp_out, aux = L.apply_mlp(lp["mlp"], m_in, cfg), jnp.zeros((), jnp.float32)
         return h + mlp_out, aux
 
+    def embed_fwd(self, embed_params, input_ids, positions=None):
+        """Token (+ learned position) embedding lookup: (B, S) → (B, S, E)."""
+        cfg = self.cfg
+        dt = cfg.act_dtype
+        h = embed_params["tok"].astype(dt)[input_ids]
+        if cfg.position == "learned":
+            if positions is None:
+                positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+            h = h + embed_params["pos"].astype(dt)[positions]
+        return h
+
+    def head_loss(self, head_params, h, labels, loss_mask=None):
+        """Final norm + lm head + cross-entropy from hidden states.
+
+        ``head_params``: {"embed": ..., "final_norm": ...} — the persistent
+        (non-layer) params. Used by the ZeRO-Infinity layer-streaming runner
+        which never materializes the full param tree on device.
+        """
+        cfg = self.cfg
+        h = L.apply_norm(head_params["final_norm"], h, cfg)
+        w, transpose = self._lm_head_weight(head_params)
+        logit_bytes = (labels.size * cfg.vocab_size
+                       * (2 if cfg.act_dtype != jnp.float32 else 4))
+        if (cfg.loss_chunks > 0 and cfg.vocab_size >= 4096
+                and logit_bytes > cfg.loss_chunk_threshold_bytes):
+            from ..ops.cross_entropy import lm_cross_entropy
+            return lm_cross_entropy(h, w.astype(h.dtype), labels, loss_mask=loss_mask,
+                                    n_chunks=cfg.loss_chunks, transpose_w=transpose)
+        dt = cfg.act_dtype
+        if transpose:
+            logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
+        else:
+            logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
+        logits = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        nll = lse - label_logits
+        if loss_mask is None:
+            return jnp.mean(nll)
+        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+
     def hidden_states(self, params, input_ids, *, positions=None, segment_ids=None):
         """Embed + layer stack + final norm: (B, S) → ((B, S, E), aux_loss)."""
         cfg = self.cfg
         dt = cfg.act_dtype
-        h = params["embed"]["tok"].astype(dt)[input_ids]
-        if cfg.position == "learned":
-            if positions is None:
-                positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
-            h = h + params["embed"]["pos"].astype(dt)[positions]
+        h = self.embed_fwd(params["embed"], input_ids, positions)
+        if cfg.position == "learned" and positions is None:
+            positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
+
+        constrain = _activation_constraint()
 
         def body(carry, lp):
             h, aux_sum = carry
             h, aux = self._layer_fn(lp, h, positions, segment_ids)
-            return (h, aux_sum + aux), None
+            return (constrain(h), aux_sum + aux), None
 
         if cfg.remat != "none":
             body = jax.checkpoint(body, policy=_remat_policy(cfg.remat))

@@ -82,8 +82,8 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     if seq_sharded:
         # Ulysses: swap sequence-sharding for head-sharding around the local
         # attention; the constraints lower to all-to-all over the seq axis.
-        head_spec = P(("data", "expert"), None, "seq", None)
-        out_spec = P(("data", "expert"), "seq", None, None)
+        head_spec = P(groups.BATCH_AXES, None, "seq", None)
+        out_spec = P(groups.BATCH_AXES, "seq", None, None)
         q = jax.lax.with_sharding_constraint(q, jax.NamedSharding(mesh, head_spec))
         k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
         v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))

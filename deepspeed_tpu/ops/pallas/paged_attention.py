@@ -1,10 +1,122 @@
-"""Paged decode attention.
+"""Pallas paged decode attention: flash attention over in-place KV pages.
 
-Analog of ``inference/v2/kernels/ragged_ops/blocked_flash`` (flash attention
-over paged KV atoms). Current implementation is the XLA gather path used by
-``inference/v2/model_runner.py`` (gather pages → masked attention); the
-Pallas kernel slot exists so the op-builder table and future in-place page
-reads share this import point.
+Analog of the reference's blocked-flash ragged kernel
+(``inference/v2/kernels/ragged_ops/blocked_flash/flash.h``): each sequence's
+KV lives scattered across fixed-size pages of a global pool; attention reads
+the pages IN PLACE via the block table — the (B, S_max, KVH, D) gathered
+cache the XLA fallback materializes never exists.
+
+TPU mapping: the block table and sequence lengths are scalar-prefetched
+(``pltpu.PrefetchScalarGridSpec``) so the kernel's BlockSpec index_map can
+chase page indices while the pipeline double-buffers page fetches. Grid =
+(batch, kv_head, page); online-softmax state (m, l, acc) lives in VMEM
+scratch carried across the page dimension of the grid. GQA runs the q-head
+group of each kv head as rows of one (G, D) tile.
+
+Decode-only (one query token per sequence); prefill chunks use the XLA
+path in ``inference/v2/model_runner.py`` where the gather amortizes over
+the chunk's matmuls.
 """
 
-from ...inference.v2.model_runner import _paged_attention as paged_attention  # noqa: F401
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(bt_ref, len_ref,            # scalar prefetch
+                   q_ref, k_ref, v_ref,        # blocks
+                   o_ref,                      # output
+                   m_ref, l_ref, acc_ref,      # VMEM scratch
+                   *, page_size, pages_max, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    seq_len = len_ref[b]
+
+    @pl.when(j * page_size < seq_len)
+    def _page():
+        q = q_ref[0, 0]                                   # (G, D)
+        k = k_ref[0, 0]                                   # (bs, D)
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)   # (G, bs)
+        if scale != 1.0:
+            s = s * scale
+        slot = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        s = jnp.where(slot < seq_len, s, NEG_INF)
+        m_prev = m_ref[...]
+        l_prev = l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(j == pages_max - 1)
+    def _finalize():
+        l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
+        o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, kpool, vpool, block_tables, seq_lens, *, scale=None):
+    """q: (B, H, D); kpool/vpool: (KVH, NB, bs, D) kv-head-major page pools;
+    block_tables: (B, MB) int32 page ids per sequence (in order);
+    seq_lens: (B,) int32 tokens currently in each sequence (incl. the one
+    being decoded). Returns (B, H, D)."""
+    b, h, d = q.shape
+    kvh, nb, page_size, _ = kpool.shape
+    mb = block_tables.shape[1]
+    group = h // kvh
+    scale = float(scale if scale is not None else d ** -0.5)
+
+    # (B, H, D) → (B, KVH, G, D): one grid cell per (batch, kv head)
+    qg = q.reshape(b, kvh, group, d)
+    kp, vp = kpool, vpool
+
+    grid = (b, kvh, mb)
+
+    def q_map(bi, hi, ji, bt, lens):
+        return (bi, hi, 0, 0)
+
+    def kv_map(bi, hi, ji, bt, lens):
+        return (hi, bt[bi, ji], 0, 0)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size, pages_max=mb,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, group, d), q_map),
+                pl.BlockSpec((1, 1, page_size, d), kv_map),
+                pl.BlockSpec((1, 1, page_size, d), kv_map),
+            ],
+            out_specs=pl.BlockSpec((1, 1, group, d), q_map),
+            scratch_shapes=[
+                pltpu.VMEM((group, 1), jnp.float32),
+                pltpu.VMEM((group, 1), jnp.float32),
+                pltpu.VMEM((group, d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+        interpret=jax.default_backend() != "tpu",
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary", "arbitrary")),
+    )(block_tables, seq_lens, qg, kp, vp)
+    return out.reshape(b, h, d)

@@ -46,7 +46,7 @@ from ..utils import groups
 # (logical_axis, mesh_axis) rules; first match wins. A mesh axis is consumed
 # at most once per parameter (XLA requirement).
 BASE_RULES: Tuple[Tuple[str, Optional[str]], ...] = (
-    ("batch", ("data", "expert")),
+    ("batch", ("zrep", "data", "expert")),
     ("seq_act", "seq"),
     ("vocab", "tensor"),
     ("heads", "tensor"),
@@ -75,10 +75,17 @@ def zero_rules(stage: int, base=BASE_RULES):
     return base
 
 
-def optimizer_state_rules(stage: int, base=BASE_RULES):
-    """Rules for optimizer-state (master weights/moments) sharding."""
+def optimizer_state_rules(stage: int, base=BASE_RULES, hpz: bool = False):
+    """Rules for optimizer-state (master weights/moments) sharding.
+
+    With ``hpz`` (ZeRO++ hierarchical partitioning, reference
+    ``groups.py:529`` + ``partition_parameters.py:1653``), optimizer state
+    shards over the FULL data-parallel world (zrep × data), while params keep
+    the within-group secondary partition — the post-step param refresh is a
+    zrep-axis allgather XLA emits from the sharding mismatch."""
     if stage >= 1:
-        return tuple(("embed", FSDP_AXIS) if r[0] == "embed" else r for r in base)
+        axes = (("zrep",) + FSDP_AXIS) if hpz else FSDP_AXIS
+        return tuple(("embed", axes) if r[0] == "embed" else r for r in base)
     return base
 
 
@@ -186,7 +193,7 @@ def batch_spec(mesh=None) -> P:
     axes, sequence over the seq axis."""
     if mesh is None:
         mesh = groups.get_mesh()
-    batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1)
+    batch_axes = tuple(a for a in groups.BATCH_AXES if mesh.shape.get(a, 1) > 1)
     seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
     return P(batch_axes if batch_axes else None, seq_axis)
 
@@ -196,3 +203,19 @@ def constrain(x, spec: P, mesh=None):
     if mesh is None:
         mesh = groups.get_mesh()
     return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+
+def current_manual_axes():
+    """Mesh axes currently in shard_map manual mode at this trace point.
+
+    Sharding constraints must not mention manual axes; layout anchors filter
+    through this so model code works both under plain SPMD jit and inside
+    partial-auto shard_map regions (e.g. the ZeRO++ quantized-collective
+    step)."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        return set(getattr(am, "manual_axes", ()) or ())
+    except Exception:
+        return set()
+
+

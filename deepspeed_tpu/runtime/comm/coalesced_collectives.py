@@ -103,3 +103,86 @@ def all_to_all_quant_reduce(tensors: List[jnp.ndarray], groups_=None,
     flat, sizes = _flatten_concat(tensors)
     reduced = quantized_reduce_scatter(flat, axis_name, group_size)
     return reduced, sizes
+
+
+# ----------------------------------------------------------------------
+# In-step ZeRO++ (qwZ weight gather / qgZ grad reduce-scatter), used by the
+# engine's shard_map training path. All functions run INSIDE a shard_map
+# manual region over `axis_name`.
+# ----------------------------------------------------------------------
+
+def quantized_reduce_scatter_along_dim(g, dim: int, axis_name: str = "data",
+                                       group_size: int = 256):
+    """Reduce-scatter a full-shape cotangent along ``dim`` with an int8 wire
+    format (qgZ). Returns this rank's reduced shard (f32)."""
+    n = jax.lax.axis_size(axis_name)
+    gm = jnp.moveaxis(g, dim, 0)
+    lead = gm.shape[0]
+    chunks = gm.reshape(n, -1)                       # row i → rank i's shard
+    c = chunks.shape[1]
+    pad = (-c) % group_size
+    if pad:
+        chunks = jnp.pad(chunks, ((0, 0), (0, pad)))
+    q, scales = quantize_int8(chunks, group_size)    # rows don't cross: C' % gs == 0
+    scales = scales.reshape(n, -1)
+    q_x = jax.lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    s_x = jax.lax.all_to_all(scales, axis_name, split_axis=0, concat_axis=0, tiled=True)
+    deq = dequantize_int8(q_x, s_x.reshape(-1, 1), jnp.float32, group_size)
+    red = jnp.sum(deq, axis=0)
+    if pad:
+        red = red[:c]
+    shard = red.reshape((lead // n,) + gm.shape[1:])
+    return jnp.moveaxis(shard, 0, dim)
+
+
+def reduce_scatter_along_dim(g, dim: int, axis_name: str = "data"):
+    """Full-precision reduce-scatter along ``dim`` (psum_scatter)."""
+    gm = jnp.moveaxis(g, dim, 0)
+    red = jax.lax.psum_scatter(gm, axis_name, scatter_dimension=0, tiled=True)
+    return jnp.moveaxis(red, 0, dim)
+
+
+def _gather_along_dim(shard, dim: int, axis_name: str, quantized: bool,
+                      group_size: int):
+    xm = jnp.moveaxis(shard, dim, 0)
+    if quantized:
+        flat = xm.reshape(-1)
+        full_flat = quantized_all_gather(flat, axis_name, group_size, xm.dtype)
+        n = jax.lax.axis_size(axis_name)
+        full = full_flat.reshape((n * xm.shape[0],) + xm.shape[1:])
+    else:
+        full = jax.lax.all_gather(xm, axis_name, axis=0, tiled=True)
+    return jnp.moveaxis(full, 0, dim)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4, 5))
+def zeropp_param_gather(shard, dim: int, axis_name: str = "data",
+                        qw: bool = True, qg: bool = True, group_size: int = 256):
+    """ZeRO++ parameter gather with gradient reduce-scatter as its VJP.
+
+    Forward (qwZ, reference ``engine.py:901`` zero_quantized_weights): the
+    ZeRO-3 param shard is all-gathered along ``dim`` over ``axis_name`` with
+    int8 + per-group scales on the wire (4x less gather traffic than fp32).
+    Backward (qgZ, reference ``coalesced_collectives.py:31``
+    all_to_all_quant_reduce): the full-shape cotangent is reduce-scattered
+    back to shards, again int8 on the wire when ``qg``.
+
+    Runs inside a shard_map manual region; straight-through estimator — the
+    quantization error is treated as noise, exactly like the reference.
+    """
+    return _gather_along_dim(shard, dim, axis_name, qw, group_size)
+
+
+def _zeropp_gather_fwd(shard, dim, axis_name, qw, qg, group_size):
+    return _gather_along_dim(shard, dim, axis_name, qw, group_size), None
+
+
+def _zeropp_gather_bwd(dim, axis_name, qw, qg, group_size, _res, g):
+    if qg:
+        shard = quantized_reduce_scatter_along_dim(g, dim, axis_name, group_size)
+    else:
+        shard = reduce_scatter_along_dim(g.astype(jnp.float32), dim, axis_name)
+    return (shard.astype(g.dtype),)
+
+
+zeropp_param_gather.defvjp(_zeropp_gather_fwd, _zeropp_gather_bwd)

@@ -76,6 +76,11 @@ class MeshConfig(DeepSpeedConfigModel):
     pipe: int = Field(1, ge=1)
     seq: int = Field(1, ge=1)
     expert: int = Field(1, ge=1)
+    # ZeRO replication groups (MiCS / hpZ): factors the data-parallel world
+    # into zrep groups of `data` devices each; params shard within a group,
+    # replicate across groups. Usually set indirectly via
+    # zero_optimization.mics_shard_size / zero_hpz_partition_size.
+    zrep: int = Field(1, ge=1)
     # how many data-axis devices form one ICI slice (for hierarchical collectives)
     replica_groups: int = Field(1, ge=1)
 
@@ -153,6 +158,20 @@ class CompileConfig(DeepSpeedConfigModel):
     donate_params: bool = True
 
 
+class PipelineConfig(DeepSpeedConfigModel):
+    """Pipeline-engine knobs (reference: PipelineEngine ctor args +
+    ``pipe/schedule.py``). ``schedule``:
+
+    - "1f1b": compiled TrainSchedule order, activation memory bounded by
+      the 1F1B in-flight cap (reference default).
+    - "1f1b-eager": same order, cap raised to the ring's bandwidth-delay
+      product — minimum bubble, ~2x activation buffers.
+    - "gpipe": fill-drain via autodiff-of-scan (round-1 path).
+    """
+    schedule: str = Field("1f1b", pattern="^(1f1b|1f1b-eager|gpipe)$")
+    remat: bool = True
+
+
 def _to_dict(config: Union[str, dict, None]) -> dict:
     if config is None:
         return {}
@@ -216,6 +235,7 @@ class DeepSpeedConfig:
         self.checkpoint_config = CheckpointConfig(**d.get(CHECKPOINT, {}))
         self.data_types = DataTypesConfig(**d.get("data_types", {}))
         self.compile_config = CompileConfig(**d.get("compile", {}))
+        self.pipeline = PipelineConfig(**d.get("pipeline", {}))
 
         from ..elasticity.config import ElasticityConfig
         self.elasticity = ElasticityConfig(d.get(ELASTICITY, {})) if ELASTICITY in d else None

@@ -102,14 +102,40 @@ class DeepSpeedEngine:
         # ---- shardings ----
         abstract = self.model.abstract_params()
         logical = self.model.logical_axes()
+        self._hpz = (self._config.zero_config.zero_hpz_partition_size > 1
+                     and self.mesh.shape.get("zrep", 1) > 1)
         self.param_shardings = shd.tree_shardings(abstract, logical,
                                                   shd.zero_rules(self.zero_stage), self.mesh)
         self._opt_param_shardings = shd.tree_shardings(
-            abstract, logical, shd.optimizer_state_rules(self.zero_stage), self.mesh)
+            abstract, logical,
+            shd.optimizer_state_rules(self.zero_stage, hpz=self._hpz), self.mesh)
         # grads: stage>=2 reduce-scattered into the optimizer layout, else like params
         self.grad_shardings = self._opt_param_shardings if self.zero_stage >= 2 else self.param_shardings
+        # Inside the (scanned) backward, constrain grads over "data" only:
+        # a joint (data, seq/expert) embed sharding as the scan-output target
+        # makes XLA's propagation demand embed-sharded activations inside the
+        # layer loop ("involuntary full rematerialization"). The full joint
+        # layout is applied in a second hop outside the loop (cheap reshard
+        # of already-reduced grads).
+        # (stage 3 grads already arrive in the params' FSDP layout — only the
+        # stage-2 replicated-params/joint-sharded-grads combination conflicts)
+        joint = (self.mesh.shape.get("seq", 1) > 1 or self.mesh.shape.get("expert", 1) > 1)
+        if self.zero_stage == 2 and joint:
+            data_only = tuple(("embed", ("data",)) if r[0] == "embed" else r
+                              for r in shd.BASE_RULES)
+            self._grad_inner_shardings = shd.tree_shardings(abstract, logical,
+                                                            data_only, self.mesh)
+        else:
+            self._grad_inner_shardings = self.grad_shardings
         self._replicated = NamedSharding(self.mesh, P())
         self.batch_sharding = NamedSharding(self.mesh, shd.batch_spec(self.mesh))
+
+        # ---- ZeRO-Infinity layer streaming (params on host / NVMe) ----
+        self._infinity = None
+        off_p = self._config.zero_config.offload_param
+        if self.zero_stage == 3 and off_p is not None and off_p.device != "none":
+            self._init_infinity(off_p)
+            return
 
         # ---- parameters ----
         seed = int(self._config._param_dict.get("seed", 42))
@@ -163,6 +189,49 @@ class DeepSpeedEngine:
                  f"micro_bs={self.train_micro_batch_size_per_gpu()} gas={self.gradient_accumulation_steps()} "
                  f"dtype={self._config.precision_dtype.__name__ if hasattr(self._config.precision_dtype, '__name__') else self._config.precision_dtype}",
                  ranks=[0])
+
+    def _init_infinity(self, off_p):
+        """Bring up the ZeRO-Infinity layer-streaming runner (params + master
+        weights + optimizer state resident on host or NVMe; see
+        ``runtime/zero/infinity.py``) and the subset of engine services it
+        needs. The compiled-step path is not built in this mode."""
+        from .zero.infinity import InfinityRunner
+        if self._config.fp16.enabled:
+            raise NotImplementedError(
+                "ZeRO-Infinity streaming supports bf16/fp32 only; fp16 loss "
+                "scaling is not applied on this path")
+        opt_cfg = self._config.optimizer
+        hyper = dict(opt_cfg.params) if opt_cfg and opt_cfg.params else {"lr": 1e-3}
+        nvme = None
+        if off_p.device == "nvme":
+            nvme = os.path.join(off_p.nvme_path or "/tmp/ds_tpu_nvme", "params")
+        group_layers = max(1, int(self._config._param_dict.get(
+            "zero_optimization", {}).get("stream_group_layers", 1)))
+        seed = int(self._config._param_dict.get("seed", 42))
+        self._infinity = InfinityRunner(self.model, self.mesh, hyper,
+                                        group_layers=group_layers, nvme_path=nvme,
+                                        buffer_count=off_p.buffer_count, seed=seed,
+                                        gradient_clipping=float(
+                                            self._config.gradient_clipping or 0.0))
+        self.module_params = None
+        self.optimizer = None
+        self.opt_state = None
+        self._opt_swapper = None
+        self.loss_scaler = create_loss_scaler(self._config.fp16, self._config.precision_dtype)
+        self.scaler_state = self.loss_scaler.init_state()
+        self.gradient_clipping = float(self._config.gradient_clipping or 0.0)
+        self.lr_scheduler = self._configure_lr_scheduler(None)
+        self.client_lr_scheduler = None
+        self.training_dataloader = None
+        self.wall_clock_breakdown = self._config.wall_clock_breakdown
+        self.timers = SynchronizedWallClockTimer() if self.wall_clock_breakdown else NoopTimer()
+        self.tput_timer = ThroughputTimer(batch_size=self.train_batch_size(),
+                                          steps_per_output=self._config.steps_per_print)
+        self.monitor = self._configure_monitor()
+        self._checkpoint_engine = None
+        log_dist(f"DeepSpeedEngine ready (ZeRO-Infinity streaming): "
+                 f"groups={self._infinity.n_groups} x {self._infinity.group_layers} layers, "
+                 f"residence={'nvme' if nvme else 'cpu'}", ranks=[0])
 
     # ------------------------------------------------------------------
     # configuration
@@ -279,10 +348,109 @@ class DeepSpeedEngine:
 
     def _loss_and_grads(self, params, batch, scale):
         """Single-microbatch scaled loss + grads with ZeRO grad layout."""
+        if self._zeropp_enabled:
+            return self._zeropp_loss_and_grads(params, batch, scale)
         def scaled_loss(p):
             loss = self.model.loss(p, batch)
             return loss * scale
         loss, grads = jax.value_and_grad(scaled_loss)(params)
+        grads = jax.tree.map(
+            lambda g, s: jax.lax.with_sharding_constraint(g, s), grads,
+            self._grad_inner_shardings)
+        return loss / scale, grads
+
+    # ------------------------------------------------------------------
+    # ZeRO++ (qwZ / qgZ): quantized collectives in the compiled step
+    # ------------------------------------------------------------------
+
+    @property
+    def _zeropp_enabled(self) -> bool:
+        zc = self._config.zero_config
+        return ((zc.zero_quantized_weights or zc.zero_quantized_gradients)
+                and self.zero_stage >= 2 and self.mesh.shape["data"] > 1)
+
+    @staticmethod
+    def _data_dim(spec) -> Optional[int]:
+        """Index of the dim a PartitionSpec shards over the 'data' axis."""
+        for i, part in enumerate(spec):
+            axes = (part,) if isinstance(part, str) else tuple(part or ())
+            if "data" in axes:
+                return i
+        return None
+
+    def _zeropp_loss_and_grads(self, params, batch, scale):
+        """Loss + grads through explicit quantized collectives (ZeRO++).
+
+        A shard_map manual region over the ``data`` axis replaces XLA's
+        sharding-derived collectives: ZeRO-3 param shards are gathered with
+        int8 on the wire (qwZ, reference ``engine.py:901``) via a custom_vjp
+        whose backward is the int8 gradient reduce-scatter (qgZ, reference
+        ``runtime/comm/coalesced_collectives.py:31``). value_and_grad runs
+        INSIDE the manual region so gradients stay rank-local until the
+        explicit (quantized) reduction.
+        """
+        from .comm.coalesced_collectives import (quantized_reduce_scatter_along_dim,
+                                                 reduce_scatter_along_dim,
+                                                 zeropp_param_gather)
+
+        zc = self._config.zero_config
+        qw = bool(zc.zero_quantized_weights)
+        qg = bool(zc.zero_quantized_gradients)
+        mesh = self.mesh
+        if mesh.shape["expert"] > 1 or mesh.shape["seq"] > 1:
+            raise NotImplementedError(
+                "ZeRO++ quantized collectives currently require expert=seq=1 "
+                "(dp × tensor × zrep meshes)")
+
+        leaves, treedef = jax.tree.flatten(self.param_shardings)
+        p_dims = [self._data_dim(s.spec) for s in leaves]
+        o_leaves = jax.tree.leaves(self._opt_param_shardings)
+        o_dims = [self._data_dim(s.spec) for s in o_leaves]
+
+        def strip(dim, ndim):
+            return P(*[("data" if i == dim else None) for i in range(ndim)])
+
+        abstract = jax.tree.leaves(self.model.abstract_params())
+        param_in_specs = treedef.unflatten(
+            [strip(d, len(a.shape)) for d, a in zip(p_dims, abstract)])
+        grad_out_specs = treedef.unflatten(
+            [strip(d if d is not None else od, len(a.shape))
+             if (d is not None or od is not None) else P(None)
+             for d, od, a in zip(p_dims, o_dims, abstract)])
+        batch_in_specs = jax.tree.map(lambda _: P("data"), batch)
+
+        def body(params, batch, scale):
+            flat_p = treedef.flatten_up_to(params)
+
+            def local_loss(flat_shards):
+                # gather INSIDE the differentiated function: its custom VJP
+                # reduce-scatters the cotangent back to shards (qgZ)
+                full = [zeropp_param_gather(p, d, "data", qw, qg)
+                        if d is not None else p for p, d in zip(flat_shards, p_dims)]
+                return self.model.loss(treedef.unflatten(full), batch) * scale
+
+            loss, grads = jax.value_and_grad(local_loss)(flat_p)
+            out = []
+            for g, d, od in zip(grads, p_dims, o_dims):
+                if d is not None:
+                    out.append(g)  # already reduce-scattered by the gather VJP
+                elif od is not None:
+                    # stage-2 layout: grads land in the optimizer sharding
+                    if qg:
+                        out.append(quantized_reduce_scatter_along_dim(g, od, "data")
+                                   .astype(g.dtype))
+                    else:
+                        out.append(reduce_scatter_along_dim(
+                            g.astype(jnp.float32), od, "data").astype(g.dtype))
+                else:
+                    out.append(jax.lax.psum(g, "data"))
+            return jax.lax.pmean(loss, "data"), treedef.unflatten(out)
+
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(param_in_specs, batch_in_specs, P()),
+                           out_specs=(P(), grad_out_specs),
+                           axis_names={"data"})
+        loss, grads = fn(params, batch, jnp.asarray(scale, jnp.float32))
         grads = jax.tree.map(
             lambda g, s: jax.lax.with_sharding_constraint(g, s), grads, self.grad_shardings)
         return loss / scale, grads
@@ -377,9 +545,13 @@ class DeepSpeedEngine:
 
                 acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
                 acc0 = jax.tree.map(lambda g, s: jax.lax.with_sharding_constraint(g, s),
-                                    acc0, self.grad_shardings)
+                                    acc0, self._grad_inner_shardings)
                 (acc, loss_sum), _ = jax.lax.scan(micro, (acc0, jnp.zeros((), jnp.float32)), batch)
                 divisor = float(gas)
+            # second hop: full ZeRO grad layout (data × seq/expert), outside
+            # the loops so the reshard is a one-shot exchange
+            acc = jax.tree.map(lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                               acc, self.grad_shardings)
             new_params, new_opt, new_scaler, overflow, grad_norm = self._apply_update(
                 params, opt_state, scaler_state, acc, lr, divisor)
             return new_params, new_opt, new_scaler, loss_sum / gas, overflow, grad_norm
@@ -392,11 +564,53 @@ class DeepSpeedEngine:
         """Pipeline-parallel step: the gas microbatches feed the pipe ring
         (reference PipelineEngine.train_batch:337); forward/backward are
         fused — the decomposed API raises, as in the reference (engine.py:61
-        PipelineEngine forbids separate forward/backward)."""
+        PipelineEngine forbids separate forward/backward).
+
+        Schedule selection (config ``pipeline.schedule``): "1f1b"/"1f1b-eager"
+        run the compiled TrainSchedule engine (explicit vjp backward, bounded
+        activation buffers, any model implementing the three-segment
+        protocol); "gpipe" keeps the autodiff fill-drain path (CausalLM
+        only)."""
         from ..models.transformer import CausalLM
-        from .pipe.engine import build_pipeline_loss
+        from .pipe.engine import (build_pipeline_1f1b, build_pipeline_loss,
+                                  _pipeline_interface)
+        pcfg = self._config.pipeline
+        use_1f1b = pcfg.schedule in ("1f1b", "1f1b-eager")
+        if use_1f1b:
+            _pipeline_interface(self.model)   # raises early if unsupported
+            pstep = build_pipeline_1f1b(self.model, self.pipe_parallel_size,
+                                        eager=(pcfg.schedule == "1f1b-eager"),
+                                        remat=pcfg.remat)
+            # Two-phase on purpose: XLA's SPMD partitioner CHECK-fails when
+            # one program contains the partial-manual pipe region AND the
+            # reshard of its mixed-residue grads (pipe-sharded layer grads +
+            # pipe-replicated embed/head grads) into the param/opt layouts.
+            # A jit boundary makes the reshard a plain runtime transfer.
+            grad_fn = jax.jit(pstep)
+
+            @functools.partial(
+                jax.jit,
+                donate_argnums=(0, 1, 2),
+                out_shardings=(self.param_shardings, self.opt_state_shardings, None,
+                               self._replicated, self._replicated))
+            def pipe_update_fn(params, opt_state, scaler_state, grads, lr):
+                return self._apply_update(params, opt_state, scaler_state,
+                                          grads, lr, jnp.float32(1.0))
+
+            def train_step_fn(params, opt_state, scaler_state, batch, lr, gas):
+                scale = scaler_state.scale
+                loss, grads = grad_fn(params, batch, scale)
+                new_params, new_opt, new_scaler, overflow, grad_norm = pipe_update_fn(
+                    params, opt_state, scaler_state, grads, lr)
+                return new_params, new_opt, new_scaler, loss, overflow, grad_norm
+
+            self._train_step_fn = train_step_fn
+            self._grad_fn = grad_fn
+            self._update_fn = pipe_update_fn
+            return
+
         assert isinstance(self.model, CausalLM), \
-            "pipeline parallelism currently requires a native CausalLM model"
+            "gpipe schedule requires a native CausalLM model"
         ploss = build_pipeline_loss(self.model, self.pipe_parallel_size)
 
         @functools.partial(
@@ -541,6 +755,17 @@ class DeepSpeedEngine:
 
         ``batch`` leaves: (gas * micro_bs, ...) or (gas, micro_bs, ...).
         """
+        if self._infinity is not None:
+            if self.gradient_accumulation_steps() != 1:
+                raise NotImplementedError(
+                    "ZeRO-Infinity streaming does not support gradient accumulation yet")
+            self.tput_timer.start()
+            loss = self._infinity.train_batch(batch, lr=float(self._next_lr()))
+            self.micro_steps += 1
+            self.global_steps += 1
+            self.global_samples += self.train_batch_size()
+            self.tput_timer.stop(global_step=True)
+            return loss
         gas = self.gradient_accumulation_steps()
         batch = jax.tree.map(self._stage_leaf, batch)
         self.tput_timer.start()
@@ -576,7 +801,11 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.step()
             return self.lr_scheduler.get_lr()[0]
-        return self.optimizer.hyper.get("lr", 1e-3)
+        if self.optimizer is not None:
+            return self.optimizer.hyper.get("lr", 1e-3)
+        if self._infinity is not None:
+            return self._infinity.adam.lr
+        return 1e-3
 
     def _next_lr_device(self):
         """Device scalar for the next step's lr, cached while unchanged
@@ -615,6 +844,17 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None, save_latest=True,
                         exclude_frozen_parameters=False):
         tag = tag or f"global_step{self.global_steps}"
+        if self._infinity is not None:
+            import pickle
+            path = os.path.join(save_dir, str(tag))
+            os.makedirs(path, exist_ok=True)
+            with open(os.path.join(path, "infinity_state.pkl"), "wb") as f:
+                pickle.dump({"runner": self._infinity.state_dict(),
+                             "meta": {"global_steps": self.global_steps}}, f)
+            if save_latest:
+                with open(os.path.join(save_dir, "latest"), "w") as f:
+                    f.write(str(tag))
+            return True
         self._swap_in_opt_state()
         state = {
             "module": self.module_params,
@@ -651,6 +891,13 @@ class DeepSpeedEngine:
                 logger.warning(f"No 'latest' file at {load_dir}; nothing loaded")
                 return None, {}
         path = os.path.join(load_dir, str(tag))
+        if self._infinity is not None:
+            import pickle
+            with open(os.path.join(path, "infinity_state.pkl"), "rb") as f:
+                blob = pickle.load(f)
+            self._infinity.load_state_dict(blob["runner"])
+            self.global_steps = blob["meta"]["global_steps"]
+            return path, {}
         template = {
             "module": (self.module_params, self.param_shardings),
             "optimizer": (self.opt_state, self.opt_state_shardings),

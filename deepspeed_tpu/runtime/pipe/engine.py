@@ -21,7 +21,7 @@ from typing import Callable
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ...utils import groups
 
@@ -95,6 +95,229 @@ def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
                          check_vma=True)
 
 
+def _pipeline_interface(model):
+    """Three-segment protocol a model must satisfy to be pipelined:
+    ``embed(other_params, batch_mb) -> h``, ``layer(layer_params, h) -> h``,
+    ``loss(other_params, h, batch_mb) -> scalar``, with params split as
+    {"layers": stacked-L pytree, **other}. Models may provide
+    ``pipe_embed/pipe_layer/pipe_loss`` directly; CausalLM is adapted from
+    its ``embed_fwd/_layer_fn/head_loss``."""
+    if hasattr(model, "pipe_embed"):
+        return model.pipe_embed, model.pipe_layer, model.pipe_loss
+
+    def embed(other, batch_mb):
+        return model.embed_fwd(other["embed"], batch_mb["input_ids"])
+
+    def layer(lp, h):
+        return model._layer_fn(lp, h, None, None)[0]
+
+    def loss(other, h, batch_mb):
+        return model.head_loss(other, h, batch_mb["labels"],
+                               batch_mb.get("loss_mask"))
+
+    return embed, layer, loss
+
+
+def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
+                        remat: bool = True):
+    """Compiled 1F1B pipeline step: ``fn(params, batch, scale) -> (loss, grads)``.
+
+    Analog of the reference 1F1B ``TrainSchedule`` walked by
+    ``PipelineEngine._exec_schedule`` (``deepspeed/runtime/pipe/engine.py:709``,
+    ``schedule.py:189``) — but compiled: the instruction stream is lowered by
+    ``schedule.compile_tick_tables`` into static per-tick activity tables and
+    the whole step is one ``lax.scan`` inside a ``shard_map`` manual over the
+    ``pipe`` axis. Per tick each stage runs a ``lax.cond``-gated forward
+    and/or backward, then two ``ppermute`` handoffs (activations +1 ring,
+    cotangents -1 ring).
+
+    Differences from the GPipe path (``pipeline_spmd``), per the round-1
+    review: the microbatch stream is never replicated in hidden-size form —
+    stages exchange single-microbatch activations and buffer at most
+    ``n_buffers`` of them (the 1F1B memory bound); embedding runs only on
+    stage 0 and the head/loss only on the last stage (``lax.cond``);
+    backward is explicit (``jax.vjp`` recompute from the buffered stage
+    input) in reference 1F1B order instead of autodiff-of-scan, so peak
+    activation memory is O(stages), not O(microbatches).
+
+    ``batch`` leaves are (M, mb, ...); returns mean loss over all M
+    microbatches and grads of ``scale * mean_loss``.
+    """
+    from .schedule import compile_tick_tables
+
+    mesh = groups.get_mesh()
+    embed_fn, layer_fn, loss_fn = _pipeline_interface(model)
+    if remat:
+        layer_fn = jax.checkpoint(layer_fn)
+
+    def step(params, batch, scale):
+        m = jax.tree.leaves(batch)[0].shape[0]
+        fwd_tab, bwd_tab, n_buf = compile_tick_tables(m, num_stages, eager)
+        other = {k: v for k, v in params.items() if k != "layers"}
+        # Replicate the embed/head params before entering the pipe region:
+        # XLA's SPMD partitioner CHECK-fails on the auto-axis (tensor)
+        # collectives the vocab-sharded head einsum needs inside the
+        # stage-varying lax.cond of a partial-manual shard_map. Cost: one
+        # all-gather of the (vocab, hidden) table per step and a replicated
+        # head matmul across the tensor group; layer compute keeps full TP.
+        rep = NamedSharding(mesh, P())
+        other = jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(x, rep), other)
+
+        def per_stage(stage_layers, other_p, batch_rep, scale_):
+            stage = jax.lax.axis_index("pipe")
+            is_first = stage == 0
+            is_last = stage == num_stages - 1
+
+            def batch_mb(i):
+                return jax.tree.map(
+                    lambda x: jax.lax.dynamic_index_in_dim(x, i, 0, keepdims=False),
+                    batch_rep)
+
+            def stage_fn(layers_p, other_pp, x, mb_idx):
+                """x: (mb, ...) incoming activation (ignored on stage 0).
+                Returns (y, per-mb loss). Embedding and head/loss are
+                ``lax.cond``-gated so middle stages execute neither (cond
+                runs — and differentiates — only the taken branch)."""
+                bmb = batch_mb(mb_idx)
+                h = jax.lax.cond(
+                    is_first,
+                    lambda xx: embed_fn(other_pp, bmb).astype(xx.dtype),
+                    lambda xx: xx, x)
+
+                def one(hh, lp):
+                    return layer_fn(lp, hh), None
+                h, _ = jax.lax.scan(one, h, layers_p)
+                lss = jax.lax.cond(
+                    is_last,
+                    lambda hh: loss_fn(other_pp, hh, bmb).astype(jnp.float32),
+                    lambda hh: jnp.zeros((), jnp.float32), h)
+                return h, lss
+
+            # probe activation shape/dtype via eval_shape (embed output)
+            mb0 = jax.eval_shape(lambda b: jax.tree.map(lambda x: x[0], b), batch_rep)
+            act_sd = jax.eval_shape(embed_fn, other_p, mb0)
+            act_shape, act_dt = act_sd.shape, act_sd.dtype
+
+            zeros_act = jnp.zeros(act_shape, act_dt)
+            x_buf0 = jnp.zeros((n_buf,) + act_shape, act_dt)
+            g_buf0 = jnp.zeros((n_buf,) + act_shape, act_dt)
+            acc_l0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), stage_layers)
+            acc_o0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), other_p)
+
+            def tick(carry, rows):
+                x_buf, g_buf, acc_l, acc_o, loss_acc = carry
+                frow, brow = rows
+                fwd_mb = frow[stage]
+                bwd_mb = brow[stage]
+
+                # ---- forward ----
+                do_fwd = fwd_mb >= 0
+                fmb = jnp.maximum(fwd_mb, 0)
+                x_in = jax.lax.dynamic_index_in_dim(x_buf, fmb % n_buf, 0,
+                                                    keepdims=False)
+
+                def fwd_branch(_):
+                    return stage_fn(stage_layers, other_p, x_in, fmb)
+
+                y, floss = jax.lax.cond(
+                    do_fwd, fwd_branch,
+                    lambda _: (zeros_act, jnp.zeros((), jnp.float32)), None)
+                loss_acc = loss_acc + floss
+
+                # ---- backward (recompute-from-stage-input + vjp) ----
+                do_bwd = bwd_mb >= 0
+                bmb = jnp.maximum(bwd_mb, 0)
+                xb = jax.lax.dynamic_index_in_dim(x_buf, bmb % n_buf, 0,
+                                                  keepdims=False)
+                gin = jax.lax.dynamic_index_in_dim(g_buf, bmb % n_buf, 0,
+                                                   keepdims=False)
+
+                zero_dl = jax.tree.map(jnp.zeros_like, acc_l)
+                zero_do = jax.tree.map(jnp.zeros_like, acc_o)
+
+                def bwd_branch(_):
+                    dy = jnp.where(is_last, jnp.zeros_like(gin), gin)
+                    dl = jnp.where(is_last, scale_ / m, 0.0).astype(jnp.float32)
+
+                    def edge(_):
+                        # first/last stage: embed or head params get grads
+                        def f(lp, op, x):
+                            return stage_fn(lp, op, x, bmb)
+                        _, pull = jax.vjp(f, stage_layers, other_p, xb)
+                        dlp_, dop_, dx_ = pull((dy, dl))
+                        return (jax.tree.map(lambda g: g.astype(jnp.float32), dlp_),
+                                jax.tree.map(lambda g: g.astype(jnp.float32), dop_),
+                                dx_.astype(act_dt))
+
+                    def middle(_):
+                        # interior stage: other_p closed over, so the vjp
+                        # never materializes (vocab, hidden) cotangents
+                        def f(lp, x):
+                            return stage_fn(lp, other_p, x, bmb)
+                        _, pull = jax.vjp(f, stage_layers, xb)
+                        dlp_, dx_ = pull((dy, dl))
+                        return (jax.tree.map(lambda g: g.astype(jnp.float32), dlp_),
+                                zero_do, dx_.astype(act_dt))
+
+                    return jax.lax.cond(is_first | is_last, edge, middle, None)
+
+                dlp, dop, dx = jax.lax.cond(
+                    do_bwd, bwd_branch,
+                    lambda _: (zero_dl, zero_do, zeros_act), None)
+                acc_l = jax.tree.map(jnp.add, acc_l, dlp)
+                # embed/head grads only exist on the first/last stage; skip
+                # the (vocab, hidden)-sized adds elsewhere
+                acc_o = jax.lax.cond(
+                    do_bwd & (is_first | is_last),
+                    lambda args: jax.tree.map(jnp.add, args[0], args[1]),
+                    lambda args: args[0], (acc_o, dop))
+
+                # ---- lockstep ring handoffs ----
+                perm_f = [(i, (i + 1) % num_stages) for i in range(num_stages)]
+                perm_b = [(i, (i - 1) % num_stages) for i in range(num_stages)]
+                y_recv = jax.lax.ppermute(y, "pipe", perm_f)
+                g_recv = jax.lax.ppermute(dx.astype(act_dt), "pipe", perm_b)
+
+                # ---- receive into ring buffers ----
+                rf = frow[(stage - 1) % num_stages]   # mb arriving forward
+                wf = (rf >= 0) & jnp.logical_not(is_first)
+                sf = jnp.maximum(rf, 0) % n_buf
+                cur = jax.lax.dynamic_index_in_dim(x_buf, sf, 0, keepdims=False)
+                x_buf = jax.lax.dynamic_update_index_in_dim(
+                    x_buf, jnp.where(wf, y_recv, cur), sf, 0)
+
+                rb = brow[(stage + 1) % num_stages]   # mb arriving backward
+                wb = (rb >= 0) & jnp.logical_not(is_last)
+                sb = jnp.maximum(rb, 0) % n_buf
+                curg = jax.lax.dynamic_index_in_dim(g_buf, sb, 0, keepdims=False)
+                g_buf = jax.lax.dynamic_update_index_in_dim(
+                    g_buf, jnp.where(wb, g_recv, curg), sb, 0)
+
+                return (x_buf, g_buf, acc_l, acc_o, loss_acc), None
+
+            carry0 = (x_buf0, g_buf0, acc_l0, acc_o0, jnp.zeros((), jnp.float32))
+            (x_buf, g_buf, acc_l, acc_o, loss_acc), _ = jax.lax.scan(
+                tick, carry0, (jnp.asarray(fwd_tab), jnp.asarray(bwd_tab)))
+
+            loss = jax.lax.psum(loss_acc, "pipe") / m     # only last stage nonzero
+            acc_o = jax.lax.psum(acc_o, "pipe")           # stage-0 embed + last head
+            return loss, acc_l, acc_o
+
+        fn = jax.shard_map(per_stage, mesh=mesh,
+                           in_specs=(P("pipe"), P(), P(), P()),
+                           out_specs=(P(), P("pipe"), P()),
+                           axis_names={"pipe"},
+                           check_vma=False)
+        loss, grads_layers, grads_other = fn(
+            params["layers"], other, batch, jnp.asarray(scale, jnp.float32))
+        grads = dict(grads_other)
+        grads["layers"] = grads_layers
+        return loss, grads
+
+    return step
+
+
 def build_pipeline_loss(model, num_stages: int):
     """Pipelined loss for a CausalLM: embed → pipe(layer stack) → head/CE.
 
@@ -111,7 +334,7 @@ def build_pipeline_loss(model, num_stages: int):
         return h
 
     pipe_run = pipeline_spmd(layer_fn, num_stages, layers_per_stage,
-                             remat=(cfg.remat != "none") or True)
+                             remat=cfg.remat != "none")
 
     def loss_fn(params, batch):
         ids = batch["input_ids"]          # (M, mb, S)

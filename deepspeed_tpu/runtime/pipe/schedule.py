@@ -178,3 +178,104 @@ class TrainSchedule(PipeSchedule):
 def bubble_fraction(micro_batches: int, stages: int) -> float:
     """Pipeline bubble overhead (p-1)/(m+p-1) — utilization planning."""
     return (stages - 1) / (micro_batches + stages - 1)
+
+
+def compile_tick_tables(micro_batches: int, stages: int, eager: bool = False):
+    """Compile the 1F1B schedule into global lockstep tick tables.
+
+    The compiled pipeline (``pipe/engine.py build_pipeline_1f1b``) runs every
+    stage through the same ``lax.scan``; per-tick activity is data, not
+    control flow. This simulates the reference TrainSchedule semantics
+    (``deepspeed/runtime/pipe/schedule.py:189``): per stage, warmup forwards
+    up to an in-flight cap, then one-forward-one-backward steady state, then
+    cooldown backwards.
+
+    ``eager=False`` uses the 1F1B cap ``stages - stage`` (the reference's
+    activation-memory bound, ``schedule.py:189`` / num_pipe_buffers). In a
+    lockstep-tick ring that cap cannot fully hide the 2(p-s)-1-tick
+    fwd→bwd round trip, so ``eager=True`` raises it to ``2*(stages-stage)-1``
+    (the bandwidth-delay product): minimum bubble, ~2x the activation
+    buffer memory.
+
+    Returns ``(fwd, bwd, n_buffers)`` — two int32 arrays of shape
+    (ticks, stages) and the activation ring-buffer depth the tables require.
+    ``fwd[t, s]`` is the microbatch whose forward stage ``s`` computes at
+    tick ``t`` (-1 = none), likewise ``bwd``. One tick admits both a forward
+    and a backward per stage (the steady-state 1F1B step). Data deps hold
+    with a one-tick handoff: ``fwd[t, s]`` only schedules microbatches whose
+    stage ``s-1`` forward finished at a tick < t (activations travel on the
+    tick-boundary ppermute), and symmetrically for backwards. The last stage
+    may backward a microbatch in its forward's own tick: its backward
+    recomputes from the stage *input*, so there is no intra-tick dependency.
+    """
+    import numpy as np
+
+    m, p = micro_batches, stages
+
+    def cap(s):
+        return (2 * (p - s) - 1) if eager else (p - s)
+
+    next_fwd = [0] * p   # next microbatch to forward, per stage
+    next_bwd = [0] * p
+    fwd_rows, bwd_rows = [], []
+    while any(nb < m for nb in next_bwd):
+        # counts at the START of this tick (handoff is on the tick boundary)
+        fwd_done = list(next_fwd)
+        bwd_done = list(next_bwd)
+        frow = [-1] * p
+        brow = [-1] * p
+        for s in range(p):
+            if s == p - 1:
+                # forward first; backward may consume the same microbatch
+                if next_fwd[s] < m and (p == 1 or next_fwd[s] < fwd_done[s - 1]):
+                    frow[s] = next_fwd[s]
+                    next_fwd[s] += 1
+                if next_bwd[s] < next_fwd[s]:
+                    brow[s] = next_bwd[s]
+                    next_bwd[s] += 1
+            else:
+                # backward first (frees an in-flight slot), then forward
+                if next_bwd[s] < m and next_bwd[s] < bwd_done[s + 1]:
+                    brow[s] = next_bwd[s]
+                    next_bwd[s] += 1
+                can_fwd = next_fwd[s] < m and (s == 0 or next_fwd[s] < fwd_done[s - 1])
+                if can_fwd and next_fwd[s] - next_bwd[s] < cap(s):
+                    frow[s] = next_fwd[s]
+                    next_fwd[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        assert len(fwd_rows) <= 4 * (m + p) + 8, "schedule simulator did not converge"
+    fwd = np.asarray(fwd_rows, np.int32)
+    bwd = np.asarray(bwd_rows, np.int32)
+    n_buf = min(m, cap(0))
+    _check_tables(fwd, bwd, m, p, n_buf)
+    return fwd, bwd, n_buf
+
+
+def _check_tables(fwd, bwd, m, p, n_buf):
+    """Trace-time verification of schedule completeness, dependency order,
+    and ring-buffer slot safety (a slot keyed ``mb % n_buf`` must not be
+    overwritten before its last reader)."""
+    import numpy as np
+
+    ft = np.full((m, p), -1)
+    bt = np.full((m, p), -1)
+    for t in range(fwd.shape[0]):
+        for s in range(p):
+            if fwd[t, s] >= 0:
+                ft[fwd[t, s], s] = t
+            if bwd[t, s] >= 0:
+                bt[bwd[t, s], s] = t
+    assert (ft >= 0).all() and (bt >= 0).all(), "schedule incomplete"
+    for i in range(m):
+        for s in range(1, p):
+            assert ft[i, s] > ft[i, s - 1], "fwd dependency violated"
+        for s in range(p - 1):
+            assert bt[i, s] > bt[i, s + 1], "bwd dependency violated"
+        assert bt[i, p - 1] >= ft[i, p - 1], "bwd before fwd at last stage"
+    for s in range(1, p):        # x_buf: written at ft[i, s-1], read at bt[i, s]
+        for i in range(m - n_buf):
+            assert ft[i + n_buf, s - 1] > bt[i, s], "x_buf slot reuse hazard"
+    for s in range(p - 1):       # g_buf: written at bt[i, s+1], read at bt[i, s]
+        for i in range(m - n_buf):
+            assert bt[i + n_buf, s + 1] > bt[i, s], "g_buf slot reuse hazard"

@@ -33,7 +33,7 @@ def sequence_parallel_cross_entropy(logits, labels, axis_name: str = "seq"):
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
         return jnp.mean(nll)
-    batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1) or None
+    batch_axes = tuple(a for a in groups.BATCH_AXES if mesh.shape.get(a, 1) > 1) or None
     lspec = P(batch_axes, axis_name, None)
     yspec = P(batch_axes, axis_name)
     all_axes = (axis_name,) + (batch_axes or ())

@@ -52,7 +52,7 @@ class DistributedAttention:
         if mesh.shape.get(axis, 1) <= 1:
             return self.local_attn(query, key, value, *args, **kwargs)
 
-        batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1) or None
+        batch_axes = tuple(a for a in groups.BATCH_AXES if mesh.shape.get(a, 1) > 1) or None
         seq_spec = P(batch_axes, axis, None, None)     # (B, S/sp, H, D)
         head_spec = P(batch_axes, None, axis, None)    # (B, S, H/sp, D)
 

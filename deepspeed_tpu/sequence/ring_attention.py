@@ -93,7 +93,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None):
     mesh = groups.get_mesh()
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
-    batch_axes = tuple(a for a in ("data", "expert") if mesh.shape.get(a, 1) > 1) or None
+    batch_axes = tuple(a for a in groups.BATCH_AXES if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch_axes, axis_name, None, None)
 
     vary_axes = (axis_name,) + (batch_axes or ())

@@ -8,10 +8,22 @@ role of process groups:
   axis       role                                  reference analog
   ---------  ------------------------------------  -----------------------------
   pipe       pipeline stages (p2p via ppermute)    PipelineParallelGrid
+  zrep       ZeRO replication (MiCS groups / hpZ)  mics.py shard groups,
+                                                   groups.py:529 hpZ secondary
   data       data parallel / ZeRO sharding         _get_data_parallel_group
   expert     expert parallel (MoE all-to-all)      _get_expert_parallel_group
   seq        sequence parallel (Ulysses/ring)      _get_sequence_parallel_group
   tensor     tensor (model) parallel               _get_model_parallel_group
+
+``zrep`` (default size 1) factors the data-parallel world into replication
+groups: batch shards over zrep×data, but ZeRO param sharding uses only the
+inner ``data`` axis — params are sharded 1/k within a group and replicated
+across groups, so their allgather rides fast intra-group links while the
+gradient reduction becomes reduce-scatter(data) + all-reduce(zrep), the MiCS
+hierarchical schedule (reference ``runtime/zero/mics.py:64,357``). With hpZ,
+optimizer state additionally shards over zrep (1/N primary partition) while
+params keep the 1/k secondary partition (reference
+``partition_parameters.py:1653`` _partition_param_sec).
 
 Axis order is outermost→innermost = slowest→fastest links: pipe and data ride
 DCN across slices, seq/expert/tensor ride ICI. ZeRO state shards over the
@@ -31,13 +43,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .logging import logger
 
-MESH_AXIS_ORDER = ("pipe", "data", "expert", "seq", "tensor")
+MESH_AXIS_ORDER = ("pipe", "zrep", "data", "expert", "seq", "tensor")
 
 # Axes whose product forms the data-parallel world used for ZeRO sharding and
 # batch distribution (seq participates in ZeRO sharding but shards the sequence
-# dim of the batch, not the batch dim).
+# dim of the batch, not the batch dim). zrep deliberately NOT in ZERO_AXES:
+# params replicate across zrep groups (MiCS/hpZ secondary partition).
 ZERO_AXES = ("data", "expert", "seq")
-BATCH_AXES = ("data", "expert")
+BATCH_AXES = ("zrep", "data", "expert")
 
 _MESH: Optional[Mesh] = None
 
@@ -52,28 +65,32 @@ def build_mesh(mesh_config=None,
                tensor: int = 1,
                pipe: int = 1,
                seq: int = 1,
-               expert: int = 1) -> Mesh:
+               expert: int = 1,
+               zrep: int = 1) -> Mesh:
     """Construct the global device mesh.
 
     ``data=-1`` (or "auto") fills with whatever devices remain after the other
-    axes are carved out.
+    axes are carved out. ``zrep`` carves ZeRO replication groups out of the
+    data-parallel world (MiCS / hpZ; see module docstring).
     """
     if mesh_config is not None:
         data = mesh_config.data if not isinstance(mesh_config.data, str) else -1
         tensor, pipe, seq, expert = (mesh_config.tensor, mesh_config.pipe, mesh_config.seq, mesh_config.expert)
+        zrep = getattr(mesh_config, "zrep", 1)
     if devices is None:
         devices = jax.devices()
     n = len(devices)
-    fixed = tensor * pipe * seq * expert
+    fixed = tensor * pipe * seq * expert * zrep
     if data in (-1, None):
         if n % fixed != 0:
-            raise MeshBuildError(f"{n} devices not divisible by tensor*pipe*seq*expert={fixed}")
+            raise MeshBuildError(f"{n} devices not divisible by tensor*pipe*seq*expert*zrep={fixed}")
         data = n // fixed
     total = data * fixed
     if total != n:
         raise MeshBuildError(f"Mesh axes product {total} != device count {n} "
-                             f"(pipe={pipe}, data={data}, expert={expert}, seq={seq}, tensor={tensor})")
-    sizes = dict(pipe=pipe, data=data, expert=expert, seq=seq, tensor=tensor)
+                             f"(pipe={pipe}, zrep={zrep}, data={data}, expert={expert}, "
+                             f"seq={seq}, tensor={tensor})")
+    sizes = dict(pipe=pipe, zrep=zrep, data=data, expert=expert, seq=seq, tensor=tensor)
     shape = tuple(sizes[a] for a in MESH_AXIS_ORDER)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, MESH_AXIS_ORDER)
