@@ -22,10 +22,14 @@ class CurriculumScheduler:
         self.state["min_difficulty"] = config["min_difficulty"]
         self.state["max_difficulty"] = config["max_difficulty"]
         self.state["current_difficulty"] = config["min_difficulty"]
-        self.state["schedule_type"] = config["curriculum_type"]
+        # curriculum_type names the difficulty metric (e.g. "seqlen");
+        # schedule_type picks the ramp. Configs predating the split used
+        # curriculum_type for both, so fall back for compatibility.
+        self.state["curriculum_type"] = config["curriculum_type"]
+        stype = config.get("schedule_type", config["curriculum_type"])
+        self.state["schedule_type"] = stype
         self.custom_get_difficulty: Optional[Callable] = None
         cfg = config.get("schedule_config", {})
-        stype = config["curriculum_type"]
         if stype in (FIXED_LINEAR, FIXED_ROOT):
             assert "total_curriculum_step" in cfg and "difficulty_step" in cfg
             self.state["schedule"] = dict(cfg)

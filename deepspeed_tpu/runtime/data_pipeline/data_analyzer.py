@@ -1,0 +1,203 @@
+"""Offline dataset analysis for curriculum learning.
+
+Analog of the reference's ``DataAnalyzer`` / ``DistributedDataAnalyzer``
+(``deepspeed/runtime/data_pipeline/data_sampling/data_analyzer.py:22,455``):
+map user metric functions over every sample of a dataset, persist the
+results as indexed datasets, and produce the inverse (metric value →
+samples) and percentile indexes that ``DeepSpeedDataSampler`` consumes as a
+curriculum difficulty source.
+
+Host-side by design (data prep never touches the accelerator). The
+reference fans out over torch dataloader workers + threads and merges
+per-worker files; here workers are a thread pool over contiguous sample
+ranges (map is numpy/user-code bound, and the merge path is identical),
+and ``DistributedDataAnalyzer`` keeps the per-worker-shard file layout so
+multi-host runs can split by rank and merge with ``merge_file_``.
+
+Outputs under ``save_path`` per metric (reference file-name parity):
+  <metric>_sample_to_metric      indexed dataset: value of each sample
+  <metric>_index_to_sample       indexed dataset: samples per sorted value
+  <metric>_index_to_metric       indexed dataset: the sorted unique values
+  <metric>_metric_value_max/min  scalar .npy
+"""
+
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .indexed_dataset import (MMapIndexedDataset, MMapIndexedDatasetBuilder,
+                              dataset_exists)
+
+SINGLE_VALUE = "single_value_per_sample"
+ACCUMULATE = "accumulate_value_over_samples"
+
+
+def _metric_path(save_path, metric_name, suffix):
+    return os.path.join(save_path, f"{metric_name}_{suffix}")
+
+
+class DataAnalyzer:
+    """Map/reduce metric analysis over an indexable dataset.
+
+    ``metric_functions`` take a batch (list of samples) and return one value
+    per sample (``single_value_per_sample``) or a partial aggregate to be
+    summed (``accumulate_value_over_samples``), mirroring the reference's
+    two metric types (``data_analyzer.py:89``).
+    """
+
+    def __init__(self, dataset, metric_names: Sequence[str],
+                 metric_functions: Sequence[Callable],
+                 metric_types: Optional[Sequence[str]] = None,
+                 save_path: str = "./data_analysis",
+                 num_workers: int = 1, batch_size: int = 1024,
+                 metric_dtypes: Optional[Sequence] = None):
+        self.dataset = dataset
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.metric_types = list(metric_types or [SINGLE_VALUE] * len(self.metric_names))
+        self.metric_dtypes = list(metric_dtypes or [np.int64] * len(self.metric_names))
+        self.save_path = save_path
+        self.num_workers = max(1, num_workers)
+        self.batch_size = batch_size
+        os.makedirs(save_path, exist_ok=True)
+
+    # ---- map ----
+
+    def _map_range(self, worker_id: int, lo: int, hi: int):
+        """Compute every metric over samples [lo, hi); returns per-metric
+        numpy arrays (single-value) or partial aggregates (accumulate)."""
+        out = []
+        for mt in self.metric_types:
+            out.append([] if mt == SINGLE_VALUE else None)
+        for start in range(lo, hi, self.batch_size):
+            batch = [self.dataset[i] for i in range(start, min(start + self.batch_size, hi))]
+            for k, (fn, mt) in enumerate(zip(self.metric_functions, self.metric_types)):
+                res = fn(batch)
+                if mt == SINGLE_VALUE:
+                    out[k].append(np.asarray(res))
+                else:
+                    out[k] = res if out[k] is None else out[k] + res
+        for k, mt in enumerate(self.metric_types):
+            if mt == SINGLE_VALUE:
+                out[k] = (np.concatenate(out[k]) if out[k]
+                          else np.zeros((0,), self.metric_dtypes[k]))
+        return out
+
+    def run_map(self):
+        """Parallel map over worker ranges → per-worker in-memory results."""
+        n = len(self.dataset)
+        bounds = np.linspace(0, n, self.num_workers + 1).astype(int)
+        ranges = [(w, bounds[w], bounds[w + 1]) for w in range(self.num_workers)]
+        if self.num_workers == 1:
+            return [self._map_range(*ranges[0])]
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            return list(pool.map(lambda r: self._map_range(*r), ranges))
+
+    # ---- reduce ----
+
+    def run_reduce(self, worker_results):
+        """Merge worker shards and write the index files (reference
+        ``merge_map_results``, ``data_analyzer.py:279``)."""
+        for k, (name, mt, dt) in enumerate(zip(self.metric_names,
+                                               self.metric_types,
+                                               self.metric_dtypes)):
+            if mt == ACCUMULATE:
+                total = None
+                for wr in worker_results:
+                    total = wr[k] if total is None else total + wr[k]
+                b = MMapIndexedDatasetBuilder(
+                    _metric_path(self.save_path, name, "accumulated"), dt)
+                b.add_item(np.asarray(total).reshape(-1))
+                b.finalize()
+                continue
+            values = np.concatenate([wr[k] for wr in worker_results]).astype(dt)
+            # sample -> metric
+            b = MMapIndexedDatasetBuilder(
+                _metric_path(self.save_path, name, "sample_to_metric"), dt)
+            for v in values:
+                b.add_item([v])
+            b.finalize()
+            # metric -> samples, ordered by value (curriculum consumption)
+            order = np.argsort(values, kind="stable")
+            uniq, starts = np.unique(values[order], return_index=True)
+            i2s = MMapIndexedDatasetBuilder(
+                _metric_path(self.save_path, name, "index_to_sample"), np.int64)
+            i2m = MMapIndexedDatasetBuilder(
+                _metric_path(self.save_path, name, "index_to_metric"), dt)
+            bounds = list(starts) + [len(order)]
+            for u, lo, hi in zip(uniq, bounds[:-1], bounds[1:]):
+                i2s.add_item(order[lo:hi])
+                i2m.add_item([u])
+            i2s.finalize()
+            i2m.finalize()
+            np.save(_metric_path(self.save_path, name, "metric_value_max.npy"),
+                    values.max() if len(values) else 0)
+            np.save(_metric_path(self.save_path, name, "metric_value_min.npy"),
+                    values.min() if len(values) else 0)
+
+    def run_map_reduce(self):
+        self.run_reduce(self.run_map())
+        return self.save_path
+
+
+class DistributedDataAnalyzer(DataAnalyzer):
+    """Rank-sharded variant (reference ``data_analyzer.py:455``): each rank
+    maps its contiguous slice and writes a shard dataset; rank 0 merges the
+    shards with ``merge_file_`` before reducing. On a multi-host TPU pod
+    each host runs with its (rank, world_size); in-process tests drive all
+    ranks sequentially."""
+
+    def __init__(self, *args, rank: int = 0, world_size: int = 1, **kw):
+        super().__init__(*args, **kw)
+        self.rank = rank
+        self.world_size = max(1, world_size)
+
+    def _shard_prefix(self, name, rank):
+        return _metric_path(self.save_path, name, f"shard{rank}")
+
+    def run_map(self):
+        n = len(self.dataset)
+        bounds = np.linspace(0, n, self.world_size + 1).astype(int)
+        lo, hi = bounds[self.rank], bounds[self.rank + 1]
+        results = self._map_range(self.rank, lo, hi)
+        for k, (name, mt, dt) in enumerate(zip(self.metric_names,
+                                               self.metric_types,
+                                               self.metric_dtypes)):
+            if mt != SINGLE_VALUE:
+                continue
+            b = MMapIndexedDatasetBuilder(self._shard_prefix(name, self.rank), dt)
+            b.add_item(np.asarray(results[k]).reshape(-1))
+            b.finalize()
+        return results
+
+    def run_map_reduce(self):
+        results = self.run_map()
+        if self.rank != 0:
+            return None
+        merged = []
+        for k, (name, mt, dt) in enumerate(zip(self.metric_names,
+                                               self.metric_types,
+                                               self.metric_dtypes)):
+            if mt != SINGLE_VALUE:
+                merged.append(results[k])   # caller sums accumulate shards
+                continue
+            parts = []
+            for r in range(self.world_size):
+                prefix = self._shard_prefix(name, r)
+                if not dataset_exists(prefix):
+                    raise FileNotFoundError(
+                        f"shard {r} for metric {name} missing — did every rank run run_map()?")
+                parts.append(np.asarray(MMapIndexedDataset(prefix)[0]))
+            merged.append(np.concatenate(parts))
+        self.run_reduce([merged])
+        return self.save_path
+
+
+def curriculum_difficulty_fn(save_path: str, metric_name: str) -> Callable[[int], float]:
+    """``difficulty_of`` callable for ``DeepSpeedDataSampler`` backed by a
+    finished analysis (the reference wires the same files into
+    ``DeepSpeedDataSampler`` via ``curriculum_learning`` config)."""
+    ds = MMapIndexedDataset(_metric_path(save_path, metric_name, "sample_to_metric"))
+    return lambda i: float(ds[i][0])

@@ -1,0 +1,148 @@
+"""Data pipeline tests: indexed dataset round-trips, analyzer map/reduce,
+curriculum wiring (reference pattern: tests/unit/runtime/test_data.py and
+data-sampling unit tests)."""
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset, MMapIndexedDatasetBuilder, best_fitting_dtype,
+    dataset_exists, make_builder, make_dataset)
+from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+    ACCUMULATE, DataAnalyzer, DistributedDataAnalyzer, curriculum_difficulty_fn)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import CurriculumScheduler
+
+
+def _write(prefix, samples, dtype=np.int32, docs=None):
+    b = MMapIndexedDatasetBuilder(prefix, dtype)
+    for i, s in enumerate(samples):
+        b.add_item(s)
+        if docs and i in docs:
+            b.end_document()
+    return b.finalize()
+
+
+def test_indexed_dataset_roundtrip(tmp_path):
+    samples = [np.arange(n, dtype=np.int32) * 3 for n in (5, 1, 17, 128)]
+    prefix = str(tmp_path / "ds")
+    ds = _write(prefix, samples)
+    assert dataset_exists(prefix)
+    assert len(ds) == 4
+    for i, s in enumerate(samples):
+        np.testing.assert_array_equal(ds[i], s)
+        assert ds.num_tokens(i) == len(s)
+    # windowed read
+    np.testing.assert_array_equal(ds.get(2, offset=3, length=4), samples[2][3:7])
+    # reopen fresh
+    ds2 = make_dataset(prefix)
+    np.testing.assert_array_equal(ds2[3], samples[3])
+    np.testing.assert_array_equal(ds2.sizes, [5, 1, 17, 128])
+
+
+def test_indexed_dataset_dtypes_and_docs(tmp_path):
+    assert best_fitting_dtype(30000) == np.uint16
+    assert best_fitting_dtype(100000) == np.int32
+    prefix = str(tmp_path / "docs")
+    b = make_builder(prefix, vocab_size=30000)
+    for s in ([1, 2, 3], [4], [5, 6]):
+        b.add_item(s)
+    b.end_document()
+    b.add_item([7, 8])
+    ds = b.finalize()
+    assert ds.dtype == np.uint16
+    np.testing.assert_array_equal(ds.doc_idx, [0, 3, 4])
+
+
+def test_indexed_dataset_merge(tmp_path):
+    a = [np.arange(4, dtype=np.int64), np.arange(2, dtype=np.int64) + 10]
+    c = [np.arange(3, dtype=np.int64) + 100]
+    _write(str(tmp_path / "a"), a, np.int64)
+    _write(str(tmp_path / "c"), c, np.int64)
+    b = MMapIndexedDatasetBuilder(str(tmp_path / "m"), np.int64)
+    b.merge_file_(str(tmp_path / "a"))
+    b.merge_file_(str(tmp_path / "c"))
+    merged = b.finalize()
+    assert len(merged) == 3
+    np.testing.assert_array_equal(merged[1], a[1])
+    np.testing.assert_array_equal(merged[2], c[0])
+
+
+def test_data_analyzer_map_reduce(tmp_path):
+    rng = np.random.default_rng(0)
+    data = [rng.integers(0, 50, rng.integers(1, 40)) for _ in range(200)]
+
+    def seqlen(batch):
+        return [len(s) for s in batch]
+
+    def total_tokens(batch):
+        return sum(len(s) for s in batch)
+
+    an = DataAnalyzer(data, ["seqlen", "total"], [seqlen, total_tokens],
+                      metric_types=["single_value_per_sample", ACCUMULATE],
+                      save_path=str(tmp_path), num_workers=3, batch_size=32)
+    an.run_map_reduce()
+
+    s2m = MMapIndexedDataset(str(tmp_path / "seqlen_sample_to_metric"))
+    assert len(s2m) == 200
+    for i in (0, 57, 199):
+        assert int(s2m[i][0]) == len(data[i])
+    # inverse index groups samples by value, ascending
+    i2m = MMapIndexedDataset(str(tmp_path / "seqlen_index_to_metric"))
+    i2s = MMapIndexedDataset(str(tmp_path / "seqlen_index_to_sample"))
+    vals = [int(i2m[k][0]) for k in range(len(i2m))]
+    assert vals == sorted(set(len(s) for s in data))
+    covered = np.concatenate([np.asarray(i2s[k]) for k in range(len(i2s))])
+    assert sorted(covered) == list(range(200))
+    for k in range(len(i2m)):
+        for si in np.asarray(i2s[k]):
+            assert len(data[si]) == vals[k]
+    acc = MMapIndexedDataset(str(tmp_path / "total_accumulated"))
+    assert int(acc[0][0]) == sum(len(s) for s in data)
+
+
+def test_distributed_data_analyzer_matches_single(tmp_path):
+    rng = np.random.default_rng(1)
+    data = [rng.integers(0, 9, rng.integers(1, 20)) for _ in range(101)]
+
+    def seqlen(batch):
+        return [len(s) for s in batch]
+
+    # every "rank" maps its shard; rank 0 merges
+    for r in range(1, 4):
+        DistributedDataAnalyzer(data, ["seqlen"], [seqlen],
+                                save_path=str(tmp_path / "dist"),
+                                rank=r, world_size=4).run_map()
+    DistributedDataAnalyzer(data, ["seqlen"], [seqlen],
+                            save_path=str(tmp_path / "dist"),
+                            rank=0, world_size=4).run_map_reduce()
+    DataAnalyzer(data, ["seqlen"], [seqlen],
+                 save_path=str(tmp_path / "single")).run_map_reduce()
+    a = MMapIndexedDataset(str(tmp_path / "dist" / "seqlen_sample_to_metric"))
+    b = MMapIndexedDataset(str(tmp_path / "single" / "seqlen_sample_to_metric"))
+    for i in range(len(data)):
+        assert int(a[i][0]) == int(b[i][0])
+
+
+def test_curriculum_sampler_uses_analysis(tmp_path):
+    data = [np.zeros(n, np.int32) for n in range(1, 41)]  # difficulty = length
+
+    def seqlen(batch):
+        return [len(s) for s in batch]
+
+    DataAnalyzer(data, ["seqlen"], [seqlen],
+                 save_path=str(tmp_path)).run_map_reduce()
+    diff = curriculum_difficulty_fn(str(tmp_path), "seqlen")
+    assert diff(0) == 1 and diff(39) == 40
+
+    sched = CurriculumScheduler({"curriculum_type": "seqlen",
+                                 "min_difficulty": 8, "max_difficulty": 40,
+                                 "schedule_type": "fixed_linear",
+                                 "schedule_config": {"total_curriculum_step": 10,
+                                                     "difficulty_step": 8}})
+    sampler = DeepSpeedDataSampler(total_samples=len(data), micro_batch_size=2,
+                                   data_parallel_size=2, shuffle=False,
+                                   curriculum_scheduler=sched, difficulty_of=diff)
+    first = next(iter(sampler))
+    # at min difficulty only samples with len <= 8 are eligible
+    assert all(len(data[i]) <= 8 for i in first)
