@@ -37,6 +37,10 @@ class TransformerConfig:
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    # "einsum": capacity-bounded one-hot dispatch (GShard/EP all-to-all);
+    # "grouped": dropless sort-by-expert + ragged_dot (megablox pattern,
+    # expert axis unsharded only)
+    moe_impl: str = "einsum"
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # stored parameter dtype

@@ -210,14 +210,61 @@ def init_moe_mlp(rng, cfg: TransformerConfig):
     return params, axes
 
 
+def apply_moe_grouped(params, x, cfg: TransformerConfig):
+    """Dropless grouped-GEMM MoE (megablox pattern; reference analog:
+    ``inference/v2/kernels/cutlass_ops/moe_gemm``): tokens are sorted by
+    assigned expert and each expert's contiguous row group hits one MXU-tiled
+    ``ragged_dot`` — no capacity buffers, no dense (T, X, C) dispatch
+    einsums, no token dropping. Selected by ``moe_impl: "grouped"``;
+    requires an unsharded expert axis (EP uses the einsum/all-to-all path).
+    """
+    from ..moe.sharded_moe import topk_gating_grouped
+    from ..ops.pallas.grouped_gemm import moe_expert_ffn
+    dt = cfg.act_dtype
+    b, s, e = x.shape
+    k = cfg.num_experts_per_tok
+    n_exp = cfg.num_experts
+    tokens = x.reshape(b * s, e)
+    t = tokens.shape[0]
+
+    logits = jnp.einsum("te,ex->tx", tokens.astype(jnp.float32),
+                        params["router"].astype(jnp.float32))
+    topk_idx, w, aux_loss = topk_gating_grouped(logits, k=k)
+
+    expert_of_row = topk_idx.reshape(-1)                      # (T*k,)
+    order = jnp.argsort(expert_of_row, stable=True)
+    tok_of_sorted = order // k                                # token each row copies
+    sorted_tokens = jnp.take(tokens, tok_of_sorted, axis=0)   # (T*k, E)
+    group_sizes = jnp.bincount(expert_of_row, length=n_exp).astype(jnp.int32)
+
+    rows = moe_expert_ffn(sorted_tokens.astype(dt),
+                          params["wi_gate"].astype(dt),
+                          params["wi_up"].astype(dt),
+                          params["wo"].astype(dt), group_sizes)
+    w_sorted = jnp.take(w.reshape(-1), order, axis=0).astype(dt)
+    out = jnp.zeros((t, e), dt).at[tok_of_sorted].add(rows * w_sorted[:, None])
+    return out.reshape(b, s, e), aux_loss
+
+
 def apply_moe_mlp(params, x, cfg: TransformerConfig):
     """Dispatch/combine via one-hot einsum (GShard-style, reference
     ``deepspeed/moe/sharded_moe.py:96 MOELayer``). Capacity-bounded, dropless
     within capacity; aux load-balancing loss returned alongside.
+
+    ``moe_impl: "grouped"`` routes to ``apply_moe_grouped`` (sort-by-expert
+    + ragged_dot) when the expert mesh axis is unsharded.
     """
     from ..moe.sharded_moe import topk_gating_einsum
     dt = cfg.act_dtype
     b, s, e = x.shape
+
+    if cfg.moe_impl == "grouped":
+        from ..utils import groups as _g
+        ep = (_g.get_mesh().shape.get("expert", 1)
+              if _g.mesh_is_initialized() else 1)
+        if ep == 1:
+            return apply_moe_grouped(params, x, cfg)
+        # EP needs the einsum dispatch (it IS the all-to-all); fall through
 
     # Explicit dispatch/combine layouts (the reference's all-to-all
     # semantics, sharded_moe.py:533 _AllToAll): tokens ride the batch axes,

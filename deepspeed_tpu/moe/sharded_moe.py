@@ -78,6 +78,24 @@ def topk_gating_einsum(logits, k: int = 2, capacity_factor: float = 1.25,
     return combine, dispatch, aux
 
 
+def topk_gating_grouped(logits, k: int = 2):
+    """Top-k gating for the grouped (megablox-style) dropless path.
+
+    Returns (topk_idx (T, k) int32, weights (T, k) fp32 normalized over the
+    k choices, aux_loss). No capacity buffers: every token reaches its
+    experts (the reference's grouped MoE GEMM semantics,
+    ``inference/v2/kernels/cutlass_ops/moe_gemm``).
+    """
+    x = logits.shape[1]
+    gates = jax.nn.softmax(logits, axis=-1)
+    topk_vals, topk_idx = jax.lax.top_k(gates, k)
+    denom = jnp.sum(topk_vals, axis=-1, keepdims=True)
+    w = topk_vals / jnp.maximum(denom, 1e-9)
+    mask_tx = jnp.sum(jax.nn.one_hot(topk_idx, x, dtype=jnp.float32), axis=1)
+    aux = load_balancing_loss(gates, mask_tx)
+    return topk_idx.astype(jnp.int32), w.astype(jnp.float32), aux
+
+
 def top1_gating_einsum(logits, capacity_factor: float = 1.0, min_capacity: int = 4):
     """Switch-style top-1 gating (reference ``top1gating:183``)."""
     return topk_gating_einsum(logits, k=1, capacity_factor=capacity_factor,
