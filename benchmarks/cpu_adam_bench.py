@@ -7,9 +7,13 @@ streaming uses on the host (``runtime/zero/infinity.py``); this shows why.
 """
 
 import json
+import os
+import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def main(n=4_000_000, iters=10):

@@ -147,9 +147,22 @@ class DeepSpeedEngine:
         # ---- optimizer ----
         self.optimizer = self._configure_optimizer(optimizer)
         self.opt_state_shardings = self._build_opt_state_shardings(abstract)
-        with self.mesh:
-            self.opt_state = jax.jit(self.optimizer.init,
-                                     out_shardings=self.opt_state_shardings)(self.module_params)
+        self._host_optimizer = None
+        off_o = self._config.zero_config.offload_optimizer
+        if off_o is not None and off_o.device == "cpu" and off_o.native:
+            # ZeRO-Offload with the NATIVE host kernel: fp32 masters/moments
+            # as host numpy, updated by csrc CPUAdam; only grads/params cross
+            # the host-device boundary (reference stage_1_and_2.py:1189).
+            from .zero.offload_host import HostOffloadOptimizer
+            self._host_optimizer = HostOffloadOptimizer(
+                self.optimizer.hyper, jax.device_get(self.module_params),
+                gradient_clipping=float(self._config.gradient_clipping or 0.0))
+            self.opt_state = self._host_optimizer.state
+            log_dist("ZeRO-Offload: native host CPUAdam in the step loop", ranks=[0])
+        else:
+            with self.mesh:
+                self.opt_state = jax.jit(self.optimizer.init,
+                                         out_shardings=self.opt_state_shardings)(self.module_params)
 
         # ---- precision / loss scaling ----
         # NVMe optimizer offload: state parked on disk between steps
@@ -502,7 +515,14 @@ class DeepSpeedEngine:
         mesh = self.mesh
         self.pipe_parallel_size = mesh.shape["pipe"]
         if self.pipe_parallel_size > 1:
+            if self._host_optimizer is not None:
+                raise NotImplementedError(
+                    "pipeline parallelism with native CPU-offload optimizer "
+                    "is not supported; set offload_optimizer.native=false")
             self._compile_pipeline_step_fns()
+            return
+        if self._host_optimizer is not None:
+            self._compile_host_offload_step_fns()
             return
 
         @functools.partial(jax.jit,
@@ -559,6 +579,75 @@ class DeepSpeedEngine:
         self._grad_fn = grad_fn
         self._update_fn = update_fn
         self._train_step_fn = train_step_fn
+
+    def _compile_host_offload_step_fns(self):
+        """Device side of the native ZeRO-Offload step: accumulate fp32
+        grads (+ their global norm-squared, so clipping costs no extra host
+        pass) on the accelerator; the update happens on host."""
+
+        @functools.partial(
+            jax.jit, static_argnames=("gas",),
+            out_shardings=(self._replicated, self.grad_shardings, self._replicated))
+        def grad_accum_fn(params, batch, scale, gas):
+            if gas == 1:
+                mb = jax.tree.map(lambda x: x[0], batch)
+                loss_sum, acc = self._loss_and_grads(params, batch=mb, scale=scale)
+                acc = jax.tree.map(lambda g: g.astype(jnp.float32), acc)
+            else:
+                def micro(carry, mb):
+                    a, ls = carry
+                    loss, grads = self._loss_and_grads(params, batch=mb, scale=scale)
+                    return (_tree_add(a, grads), ls + loss), None
+
+                acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+                acc0 = jax.tree.map(lambda g, s: jax.lax.with_sharding_constraint(g, s),
+                                    acc0, self._grad_inner_shardings)
+                (acc, loss_sum), _ = jax.lax.scan(
+                    micro, (acc0, jnp.zeros((), jnp.float32)), batch)
+            gsq = sum(jnp.vdot(g, g).astype(jnp.float32) for g in jax.tree.leaves(acc))
+            return loss_sum / gas, acc, gsq
+
+        self._grad_accum_fn = grad_accum_fn
+        self._train_step_fn = None
+        self._grad_fn = None
+        self._update_fn = None
+
+    def _host_offload_train_batch(self, batch):
+        """Native ZeRO-Offload step: device grads → host CPUAdam → re-staged
+        params. Overflow handling and dynamic loss scaling match the
+        compiled path (skip update, shrink scale)."""
+        import numpy as np
+        gas = self.gradient_accumulation_steps()
+        batch = jax.tree.map(self._stage_leaf, batch)
+        self.tput_timer.start()
+        scale_dev = self.scaler_state.scale
+        loss, acc, gsq = self._grad_accum_fn(self.module_params, batch,
+                                             scale_dev, gas=gas)
+        for x in jax.tree.leaves(acc):
+            x.copy_to_host_async()
+        gsq_f = float(gsq)
+        scale = float(jax.device_get(scale_dev))
+        divisor = scale * gas
+        overflow = not np.isfinite(gsq_f)
+        self.scaler_state = self.loss_scaler.update(self.scaler_state,
+                                                    jnp.asarray(overflow))
+        grad_norm = float("nan")
+        if overflow:
+            self.skipped_steps += 1
+        else:
+            g_host = jax.tree.map(np.asarray, acc)
+            grad_norm = (gsq_f ** 0.5) / divisor
+            new_params = self._host_optimizer.step(
+                g_host, grad_divisor=divisor, lr=float(self._next_lr()),
+                grad_norm_sq=gsq_f / (divisor * divisor))
+            self.module_params = jax.device_put(new_params, self.param_shardings)
+        self._last_grad_norm = grad_norm
+        self.micro_steps += gas
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self._post_step(jnp.asarray(overflow), jnp.asarray(grad_norm))
+        self.tput_timer.stop(global_step=True)
+        return loss
 
     def _compile_pipeline_step_fns(self):
         """Pipeline-parallel step: the gas microbatches feed the pipe ring
@@ -766,6 +855,8 @@ class DeepSpeedEngine:
             self.global_samples += self.train_batch_size()
             self.tput_timer.stop(global_step=True)
             return loss
+        if self._host_optimizer is not None:
+            return self._host_offload_train_batch(batch)
         gas = self.gradient_accumulation_steps()
         batch = jax.tree.map(self._stage_leaf, batch)
         self.tput_timer.start()
@@ -900,7 +991,9 @@ class DeepSpeedEngine:
             return path, {}
         template = {
             "module": (self.module_params, self.param_shardings),
-            "optimizer": (self.opt_state, self.opt_state_shardings),
+            "optimizer": (self.opt_state,
+                          None if self._host_optimizer is not None
+                          else self.opt_state_shardings),
             "scaler": (self.scaler_state._asdict(), None),
         }
         state = self._ckpt_engine().load(path, template)
@@ -908,9 +1001,16 @@ class DeepSpeedEngine:
         if load_module_only:
             return path, state["meta"].get("client_state", {})
         if load_optimizer_states:
-            self.opt_state = state["optimizer"]
-        self.scaler_state = LossScaleState(**{k: jnp.asarray(v)
-                                              for k, v in state["scaler"].items()})
+            if self._host_optimizer is not None:
+                self._host_optimizer.load_state_dict(state["optimizer"])
+                self.opt_state = self._host_optimizer.state
+                self.module_params = jax.device_put(self._host_optimizer.params(),
+                                                    self.param_shardings)
+            else:
+                self.opt_state = state["optimizer"]
+        self.scaler_state = LossScaleState(**{
+            k: jax.device_put(jnp.asarray(v), self._replicated)
+            for k, v in state["scaler"].items()})
         meta = state["meta"]
         self.global_steps = int(meta["global_steps"])
         self.global_samples = int(meta["global_samples"])

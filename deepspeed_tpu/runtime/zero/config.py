@@ -41,6 +41,11 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     nvme_path: Optional[str] = None
     buffer_count: int = Field(4, ge=0)
     pin_memory: bool = False
+    # device == "cpu": run the update with the native host CPUAdam kernel
+    # (reference DeepSpeedCPUAdam, csrc/adam/cpu_adam.cpp) on host-resident
+    # fp32 masters/moments; False keeps state in accelerator-attached host
+    # memory (memory_kind) with the update compiled on device.
+    native: bool = True
     pipeline_read: bool = False
     pipeline_write: bool = False
     fast_init: bool = False

@@ -1,0 +1,94 @@
+"""ZeRO-Offload host optimizer: the native CPUAdam in the engine loop.
+
+Analog of the reference's CPU-offload step (``runtime/zero/stage_1_and_2.py:1189``
+grad offload → ``csrc/adam/cpu_adam.cpp`` DeepSpeedCPUAdam on pinned host
+tensors → fp16 params re-staged to device). The compiled step computes and
+accumulates gradients on the accelerator; this class owns the fp32 master
+weights and Adam moments as host numpy arrays and updates them with the
+native AVX/OpenMP kernel (``ops/csrc/adam/cpu_adam.cpp`` via ctypes), then
+returns the low-precision param tree to re-stage on device.
+
+State layout matches the device optimizers ({"step", "slots": {m, v,
+master}}), so checkpoint save/load round-trips through the same engine
+paths. Single-host semantics: grads are fetched as full (replicated)
+arrays; per-rank sharded host state is a multi-process concern
+(``jax.distributed``) out of scope here.
+"""
+
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from .infinity import _HostAdam
+
+
+class HostOffloadOptimizer:
+    """fp32 master + moments on host, native CPUAdam update, cast-out params."""
+
+    def __init__(self, hyper: Dict[str, Any], param_tree, *,
+                 gradient_clipping: float = 0.0):
+        self.adam = _HostAdam(hyper)
+        self.hyper = dict(hyper)
+        self.gradient_clipping = float(gradient_clipping or 0.0)
+        host_p = jax.tree.map(lambda x: np.asarray(x, np.float32), param_tree)
+        self._dtypes = jax.tree.map(lambda x: x.dtype, param_tree)
+        self.state = {
+            "step": np.zeros((), np.int32),
+            "slots": jax.tree.map(
+                lambda p: {"m": np.zeros_like(p), "v": np.zeros_like(p),
+                           "master": p}, host_p,
+                is_leaf=lambda x: isinstance(x, np.ndarray)),
+        }
+
+    def step(self, host_grads, *, grad_divisor: float = 1.0,
+             lr: Optional[float] = None,
+             grad_norm_sq: Optional[float] = None) -> Any:
+        """Update masters in place from host fp32 grads; returns the new
+        param tree in the original (possibly low-precision) dtypes.
+
+        ``grad_divisor`` folds loss-scale × gradient-accumulation unscaling
+        into the same pass as clipping. ``grad_norm_sq`` is the UNSCALED
+        global grad norm squared if the caller computed it on device;
+        otherwise it is computed here.
+        """
+        step_num = int(self.state["step"]) + 1
+        self.state["step"] = np.asarray(step_num, np.int32)
+        flat_g = jax.tree.leaves(host_grads)
+        flat_s = jax.tree.leaves(self.state["slots"],
+                                 is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+        scale = 1.0 / grad_divisor
+        if self.gradient_clipping > 0.0:
+            if grad_norm_sq is None:
+                grad_norm_sq = sum(float(np.vdot(g, g)) for g in flat_g) * scale * scale
+            gnorm = math.sqrt(grad_norm_sq)
+            scale *= min(1.0, self.gradient_clipping / (gnorm + 1e-6))
+        for g, s in zip(flat_g, flat_s):
+            gh = np.asarray(g, dtype=np.float32)
+            if scale != 1.0:
+                gh = gh * scale          # also makes a writable copy
+            elif not gh.flags.writeable or not gh.flags.c_contiguous:
+                gh = np.array(gh)        # jax host views are read-only
+            self.adam.step(s["master"], gh, s["m"], s["v"], step_num, lr)
+        return self.params()
+
+    def params(self):
+        """Current params cast back to their training dtypes (host arrays)."""
+        masters = jax.tree.map(
+            lambda s: s["master"], self.state["slots"],
+            is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+        return jax.tree.map(lambda p, dt: p.astype(dt) if dt != np.float32 else p,
+                            masters, self._dtypes)
+
+    # ---- checkpoint interop (same structure as device optimizers) ----
+
+    def state_dict(self):
+        return self.state
+
+    def load_state_dict(self, sd):
+        self.state = {
+            "step": np.asarray(jax.device_get(sd["step"]), np.int32),
+            "slots": jax.tree.map(lambda x: np.asarray(jax.device_get(x), np.float32),
+                                  sd["slots"]),
+        }
