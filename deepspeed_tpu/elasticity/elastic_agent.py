@@ -1,0 +1,102 @@
+"""Elastic training agent.
+
+Analog of ``deepspeed/elasticity/elastic_agent.py:32`` (DSElasticAgent, an
+extension of torch-elastic's LocalElasticAgent): supervise the worker
+group, and when workers die — or the node set changes — restart them at a
+world size the precomputed elastic batch configuration admits, resuming
+from the latest checkpoint. Torch-elastic's rendezvous is replaced by the
+launcher's hostfile contract: ``jax.distributed.initialize`` performs the
+actual process-group bring-up on restart.
+"""
+
+import subprocess
+import sys
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .elasticity import ElasticityIncompatibleWorldSize, compute_elastic_config
+
+
+class WorkerSpec:
+    """What to (re)launch: argv builder parameterized by world size."""
+
+    def __init__(self, cmd_for_world: Callable[[int], List[str]],
+                 env: Optional[Dict[str, str]] = None):
+        self.cmd_for_world = cmd_for_world
+        self.env = env
+
+
+class ElasticAgent:
+    """Restart loop with elastic world-size renegotiation.
+
+    ``available_nodes_fn`` reports currently healthy device counts (on a
+    TPU pod slice: live hosts × chips per host); after a worker failure the
+    agent drops to the largest valid world size ≤ what is available and
+    relaunches. ``max_restarts`` bounds the loop (reference torch-elastic
+    semantics); a clean exit ends it.
+    """
+
+    def __init__(self, ds_config: Dict, spec: WorkerSpec,
+                 available_nodes_fn: Callable[[], int],
+                 max_restarts: int = 3, backoff_s: float = 5.0):
+        self.ds_config = ds_config
+        self.spec = spec
+        self.available_nodes_fn = available_nodes_fn
+        self.max_restarts = max_restarts
+        self.backoff_s = backoff_s
+        self.restart_count = 0
+
+    def _negotiate_world_size(self) -> int:
+        available = int(self.available_nodes_fn())
+        _, valid_gpus = compute_elastic_config(self.ds_config)
+        fits = [g for g in valid_gpus if g <= available]
+        if not fits:
+            raise ElasticityIncompatibleWorldSize(
+                f"no valid world size <= available {available} in {valid_gpus}")
+        return max(fits)
+
+    def run(self) -> int:
+        while True:
+            world = self._negotiate_world_size()
+            cmd = self.spec.cmd_for_world(world)
+            logger.info(f"[elastic-agent] launching world_size={world}: {cmd}")
+            proc = subprocess.Popen(cmd, env=self.spec.env)
+            rc = proc.wait()
+            if rc == 0:
+                return 0
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                logger.error(f"[elastic-agent] giving up after "
+                             f"{self.max_restarts} restarts (last rc={rc})")
+                return rc
+            logger.warning(f"[elastic-agent] worker group failed rc={rc}; "
+                           f"restart {self.restart_count}/{self.max_restarts} "
+                           f"in {self.backoff_s}s")
+            time.sleep(self.backoff_s)
+
+
+def cli_main(argv=None):
+    """``ds_elastic`` analog: inspect/validate an elastic config."""
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser(
+        description="Show compatible world sizes for an elastic DeepSpeed config")
+    parser.add_argument("-c", "--config", required=True, help="ds_config json path")
+    parser.add_argument("-w", "--world-size", type=int, default=0,
+                        help="validate this world size against the config")
+    args = parser.parse_args(argv)
+    with open(args.config) as f:
+        ds_config = json.load(f)
+    if args.world_size:
+        batch, valid, mb = compute_elastic_config(ds_config, world_size=args.world_size,
+                                                  return_microbatch=True)
+        print(f"world size: {args.world_size}")
+        print(f"final train_batch_size: {batch}")
+        print(f"micro_batch_per_gpu: {mb}")
+    else:
+        batch, valid = compute_elastic_config(ds_config)
+        print(f"final train_batch_size: {batch}")
+        print(f"valid world sizes: {valid}")
+    return 0

@@ -3,9 +3,20 @@
 Analog of ``deepspeed/elasticity/elasticity.py`` (``compute_elastic_config:
 233``, candidate batch/GPU math ``:27-126``): precompute batch sizes valid
 across a range of accelerator counts so scaling events keep
-batch-size-sensitive hyperparameters fixed. Pure math — identical semantics.
+batch-size-sensitive hyperparameters fixed.
+
+Semantics match the reference: candidate global batch sizes are each base
+(every micro-batch size plus their LCM) scaled by the largest highly
+composite number that keeps the product under the acceptable maximum —
+HCNs maximize the divisor count, i.e. the number of compatible device
+counts. Valid device counts are the divisors of batch/micro_batch within
+[min, max]. v0.2 additionally works at node granularity with a model
+parallel degree (``_get_compatible_gpus_v02``, reference ``:129``).
+The HCN table is generated, not transcribed.
 """
 
+import math
+from functools import lru_cache, reduce
 from typing import Dict, List, Tuple
 
 from ..utils.logging import logger
@@ -25,52 +36,162 @@ class ElasticityIncompatibleWorldSize(ElasticityError):
     pass
 
 
+def _divisor_count(n: int) -> int:
+    c = 1
+    d = 2
+    while d * d <= n:
+        if n % d == 0:
+            e = 0
+            while n % d == 0:
+                n //= d
+                e += 1
+            c *= e + 1
+        d += 1
+    if n > 1:
+        c *= 2
+    return c
+
+
+@lru_cache(maxsize=1)
+def _hcn_list(limit: int = 750_000) -> Tuple[int, ...]:
+    """Highly composite numbers ≤ limit (record-setting divisor counts).
+
+    Every HCN is a product of the first k primes with non-increasing
+    exponents, so enumerating that family and keeping divisor-count records
+    reproduces the sequence without a full scan."""
+    primes = (2, 3, 5, 7, 11, 13, 17)
+
+    def gen(i, value, max_exp, out):
+        out.append(value)
+        if i == len(primes):
+            return
+        p = primes[i]
+        v = value
+        for e in range(1, max_exp + 1):
+            v *= p
+            if v > limit:
+                break
+            gen(i + 1, v, e, out)
+
+    family: List[int] = []
+    gen(0, 1, 40, family)
+    records = []
+    best = 0
+    for n in sorted(set(family)):
+        c = _divisor_count(n)
+        if c > best:
+            best = c
+            records.append(n)
+    return tuple(records)
+
+
+def _largest_hcn_at_most(value: int) -> int:
+    hcns = _hcn_list()
+    best = 1
+    for h in hcns:
+        if h > value:
+            break
+        best = h
+    return best
+
+
 def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
-    """All batch sizes b = base * 2^k ≤ max, deduped ascending (ref ``:27``)."""
+    """For each base, the largest base × HCN ≤ max (reference ``:27``)."""
     candidates = set()
     for base in base_list:
-        b = base
-        while b <= max_acceptable_batch_size:
-            candidates.add(b)
-            b *= 2
-    return sorted(candidates)
+        if base >= max_acceptable_batch_size:
+            candidates.add(base)
+        else:
+            candidates.add(base * _largest_hcn_at_most(max_acceptable_batch_size // base))
+    out = sorted(candidates)
+    logger.info(f"Candidate batch sizes: {out}")
+    return out
 
 
 def get_valid_gpus(batch_size: int, micro_batches: List[int], min_valid_gpus: int,
                    max_valid_gpus: int) -> List[int]:
-    """GPU counts g where batch_size % (g * mb) == 0 for some micro batch
-    (ref ``:44``)."""
+    """Device counts g dividing batch/mb for some micro batch — i.e. the
+    divisors of each quotient, bounded to [min, max] (reference ``:41``)."""
     valid = set()
     for mb in micro_batches:
         if batch_size % mb != 0:
             continue
-        max_gpus = batch_size // mb
-        for g in range(1, max_gpus + 1):
-            if batch_size % (g * mb) == 0 and min_valid_gpus <= g <= max_valid_gpus:
-                valid.add(g)
+        q = batch_size // mb
+        d = 1
+        while d * d <= q:
+            if q % d == 0:
+                for g in (d, q // d):
+                    if min_valid_gpus <= g <= max_valid_gpus:
+                        valid.add(g)
+            d += 1
     return sorted(valid)
 
 
 def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[int],
                         min_gpus: int, max_gpus: int, prefer_larger: bool):
-    """(batch, valid_gpus) maximizing GPU-count coverage (ref ``:63``)."""
+    """(batch, valid_gpus) maximizing device-count coverage, batch size as
+    the tie-break in the preferred direction (reference ``:63``)."""
     max_valid = 0
-    best_batch = None
-    best_gpus = []
+    best_batch = min(micro_batches)
+    best_gpus = None
     for batch in candidate_batch_sizes:
         gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
-        if len(gpus) > max_valid or (len(gpus) == max_valid and prefer_larger and
-                                     best_batch is not None and batch > best_batch):
+        better_tie = (prefer_larger and batch > best_batch) or \
+                     (not prefer_larger and batch < best_batch)
+        if len(gpus) > max_valid or (len(gpus) == max_valid and better_tie):
             max_valid = len(gpus)
             best_batch = batch
             best_gpus = gpus
     return best_batch, best_gpus
 
 
-def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=1,
-                             max_gpus=10000, prefer_larger=True):
-    candidates = get_candidate_batch_sizes(micro_batches, max_acceptable_batch_size)
+def _get_compatible_gpus_v01(micro_batches, max_acceptable_batch_size, min_gpus=None,
+                             max_gpus=None, prefer_larger=True):
+    min_gpus = min_gpus or 1
+    max_gpus = max_gpus or max_acceptable_batch_size // min(micro_batches)
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityConfigError(
+            f"every micro batch must be <= max_acceptable_batch_size="
+            f"{max_acceptable_batch_size}")
+    lcm = reduce(math.lcm, micro_batches)
+    base_list = list(micro_batches) + [lcm]
+    candidates = get_candidate_batch_sizes(base_list, max_acceptable_batch_size)
     return get_best_candidates(candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+
+
+def _get_compatible_gpus_v02(micro_batches, max_acceptable_batch_size, current_num_gpus,
+                             min_gpus=None, max_gpus=None, prefer_larger=True,
+                             num_gpus_per_node=1, model_parallel_size=1):
+    """Node-granular variant with model parallelism (reference ``:129``):
+    elasticity counts nodes, each contributing num_gpus_per_node /
+    model_parallel_size data-parallel ranks."""
+    if num_gpus_per_node % model_parallel_size != 0:
+        raise ElasticityError(
+            f"num_gpus_per_node={num_gpus_per_node} must be divisible by "
+            f"model_parallel_size={model_parallel_size}")
+    dp_per_node = num_gpus_per_node // model_parallel_size
+
+    batch, valid_nodes = _get_compatible_gpus_v01(
+        micro_batches, int(max_acceptable_batch_size / dp_per_node),
+        int((min_gpus or 1) / num_gpus_per_node) or 1,
+        int((max_gpus or current_num_gpus) / num_gpus_per_node) or 1,
+        prefer_larger=prefer_larger)
+    final_batch = int(batch) * dp_per_node
+    valid_dp = [n * dp_per_node for n in (valid_nodes or [])]
+
+    def pick_micro(fb):
+        chosen = None
+        for mb in micro_batches:
+            if (fb // max(current_num_gpus, 1)) % mb == 0:
+                if chosen is None or (prefer_larger and mb > chosen):
+                    chosen = mb
+        return chosen
+
+    if current_num_gpus // model_parallel_size in valid_dp:
+        return final_batch, valid_dp, pick_micro(final_batch)
+    raise ElasticityIncompatibleWorldSize(
+        f"current world {current_num_gpus} (mp={model_parallel_size}) not in "
+        f"valid data-parallel set {valid_dp}")
 
 
 def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
@@ -86,8 +207,18 @@ def compute_elastic_config(ds_config: Dict, target_deepspeed_version: str = "",
     min_gpus = elastic.get("min_gpus", 1)
     max_gpus = elastic.get("max_gpus", 10000)
     prefer_larger = elastic.get("prefer_larger_batch", True)
+    version = float(elastic.get("version", 0.1))
     if not micro_batches or max_batch <= 0:
         raise ElasticityConfigError("micro_batch_sizes and max_train_batch_size required")
+
+    if version >= 0.2 and world_size > 0:
+        final_batch, valid_gpus, mb = _get_compatible_gpus_v02(
+            micro_batches, max_batch, world_size, min_gpus, max_gpus, prefer_larger,
+            num_gpus_per_node=elastic.get("num_gpus_per_node", 1),
+            model_parallel_size=elastic.get("model_parallel_size", 1))
+        if return_microbatch:
+            return final_batch, valid_gpus, mb
+        return final_batch, valid_gpus
 
     final_batch, valid_gpus = _get_compatible_gpus_v01(
         micro_batches, max_batch, min_gpus, max_gpus, prefer_larger)

@@ -79,6 +79,8 @@ class DeepSpeedEngine:
         self._cached = None          # (loss, grads) from forward, consumed by backward
         self._acc_grads = None
         self._acc_count = 0
+        self._pending_overflow = []  # device flags, drained at steps_per_print
+        self._eval_fn = None
 
         if not dist.is_initialized():
             dist.init_distributed(verbose=False)
@@ -632,9 +634,7 @@ class DeepSpeedEngine:
         self.scaler_state = self.loss_scaler.update(self.scaler_state,
                                                     jnp.asarray(overflow))
         grad_norm = float("nan")
-        if overflow:
-            self.skipped_steps += 1
-        else:
+        if not overflow:
             g_host = jax.tree.map(np.asarray, acc)
             grad_norm = (gsq_f ** 0.5) / divisor
             new_params = self._host_optimizer.step(
@@ -645,7 +645,7 @@ class DeepSpeedEngine:
         self.micro_steps += gas
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        self._post_step(jnp.asarray(overflow), jnp.asarray(grad_norm))
+        self._post_step(jnp.asarray(overflow), jnp.asarray(grad_norm), loss)
         self.tput_timer.stop(global_step=True)
         return loss
 
@@ -869,14 +869,15 @@ class DeepSpeedEngine:
         self.micro_steps += gas
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
-        self._post_step(overflow, grad_norm)
+        self._post_step(overflow, grad_norm, loss)
         self.tput_timer.stop(global_step=True)
         return loss
 
     def eval_batch(self, batch):
+        if self._eval_fn is None:
+            self._eval_fn = jax.jit(self.model.loss)
         batch = self._put_batch(batch)
-        loss = jax.jit(self.model.loss)(self.module_params, batch)
-        return loss
+        return self._eval_fn(self.module_params, batch)
 
     def _swap_in_opt_state(self):
         if self._opt_swapper is not None and self.opt_state is None:
@@ -908,18 +909,42 @@ class DeepSpeedEngine:
             self._lr_cache = (lr, jnp.float32(lr))
         return self._lr_cache[1]
 
-    def _post_step(self, overflow, grad_norm):
-        if self.monitor is not None and getattr(self.monitor, "enabled", False) and \
-                self.global_steps % max(1, self._config.steps_per_print) == 0:
-            self.monitor.write_events([("Train/lr", self._current_lr(), self.global_steps)])
-        if self._config.steps_per_print and self.global_steps % self._config.steps_per_print == 0:
-            try:
-                if bool(overflow):
-                    self.skipped_steps += 1
-                    log_dist(f"step={self.global_steps} OVERFLOW, scale -> "
-                             f"{float(self.scaler_state.scale)}", ranks=[0])
-            except Exception:
-                pass
+    def _post_step(self, overflow, grad_norm, loss=None):
+        """Bookkeeping at the gradient-update boundary.
+
+        Device scalars are queued WITHOUT forcing a sync (a per-step fence
+        would serialize host and device on remote platforms); once per
+        ``steps_per_print`` window everything is fetched at once and fanned
+        out to the monitor — loss/lr/loss-scale/grad-norm/throughput, the
+        samples the reference engine writes (``engine.py:2001,2222``) — and
+        the rank-0 progress log."""
+        self._pending_overflow.append(overflow)
+        spp = max(1, int(self._config.steps_per_print or 10 ** 9))
+        if self.global_steps % spp != 0:
+            return
+        n_over = sum(int(jax.device_get(o)) for o in self._pending_overflow)
+        self._pending_overflow.clear()
+        self.skipped_steps += n_over
+        scale = float(jax.device_get(self.scaler_state.scale)) \
+            if self.scaler_state is not None else 1.0
+        gnorm = float(jax.device_get(grad_norm)) if grad_norm is not None else None
+        lval = float(jax.device_get(loss)) if loss is not None else None
+        lr = self._current_lr()
+        tput = self.tput_timer.avg_samples_per_sec()
+        if n_over:
+            log_dist(f"step={self.global_steps} {n_over} OVERFLOW step(s) in "
+                     f"window, scale -> {scale}", ranks=[0])
+        if self.monitor is not None and getattr(self.monitor, "enabled", False):
+            step = self.global_steps
+            events = [("Train/lr", lr, step),
+                      ("Train/loss_scale", scale, step)]
+            if lval is not None:
+                events.append(("Train/loss", lval, step))
+            if gnorm is not None:
+                events.append(("Train/grad_norm", gnorm, step))
+            if tput > 0:
+                events.append(("Train/samples_per_sec", tput, step))
+            self.monitor.write_events(events)
 
     # ------------------------------------------------------------------
     # checkpointing (reference engine.py:2763-3607)

@@ -82,7 +82,10 @@ def test_elastic_config_math():
     from deepspeed_tpu.elasticity.elasticity import (compute_elastic_config,
                                                      get_candidate_batch_sizes,
                                                      get_valid_gpus)
-    assert get_candidate_batch_sizes([8, 12], 50) == [8, 12, 16, 24, 32, 48]
+    # reference HCN semantics: each base scaled by the largest highly
+    # composite number keeping it under the cap (8*6=48, 12*4=48)
+    assert get_candidate_batch_sizes([8, 12], 50) == [48]
+    assert get_candidate_batch_sizes([7], 50) == [42]
     assert get_valid_gpus(16, [2, 4], 1, 100) == [1, 2, 4, 8]
     cfg = {"elasticity": {"enabled": True, "micro_batch_sizes": [2, 4],
                           "max_train_batch_size": 64, "min_gpus": 1, "max_gpus": 16}}
@@ -263,3 +266,33 @@ def test_hybrid_engine_generate(mesh_8dp):
     engine.train_batch({"input_ids": ids, "labels": ids})
     out2 = engine.generate(prompt, max_new_tokens=4, temperature=0.0)
     assert out2.shape == (2, 12)
+
+
+def test_engine_emits_monitor_events(tmp_path):
+    """The engine writes loss/lr/loss-scale/grad-norm/throughput samples to
+    the monitor every steps_per_print (reference engine.py:2001,2222), not
+    just lr."""
+    import csv as csv_mod
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(data=8))
+    cfg = {
+        "train_batch_size": 16,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 2,
+        "csv_monitor": {"enabled": True, "output_path": str(tmp_path),
+                        "job_name": "t"},
+    }
+    engine, _, _, _ = ds.initialize(model=build_model("tiny"), config=cfg)
+    rng = np.random.default_rng(0)
+    for _ in range(4):
+        ids = rng.integers(0, 256, (16, 32))
+        engine.train_batch({"input_ids": ids, "labels": ids})
+    files = list((tmp_path).rglob("*.csv"))
+    names = {f.stem.split("-")[-1] if "-" in f.stem else f.stem for f in files}
+    joined = " ".join(str(f) for f in files)
+    for key in ("loss", "lr", "loss_scale"):
+        assert any(key in str(f) for f in files), (key, files)
