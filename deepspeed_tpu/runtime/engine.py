@@ -526,6 +526,9 @@ class DeepSpeedEngine:
         if self._host_optimizer is not None:
             self._compile_host_offload_step_fns()
             return
+        self._onebit = getattr(self.optimizer, "name", "").startswith(("onebit", "zero_one"))
+        if self._onebit:
+            self._prepare_onebit()
 
         @functools.partial(jax.jit,
                            out_shardings=(self._replicated, self.grad_shardings))
@@ -581,6 +584,147 @@ class DeepSpeedEngine:
         self._grad_fn = grad_fn
         self._update_fn = update_fn
         self._train_step_fn = train_step_fn
+
+    def _prepare_onebit(self):
+        """Set up the COMPRESSED-communication stage of the 1-bit optimizers
+        (reference ``runtime/fp16/onebit/adam.py:14``): after ``freeze_step``,
+        gradients are never reduced at full precision — each rank updates a
+        LOCAL momentum from its local gradients and the momentum travels
+        through the error-feedback 1-bit allreduce
+        (``runtime/comm/compressed.py``), variance frozen. Warmup steps use
+        the exact-Adam compiled path."""
+        if self.zero_stage != 0:
+            raise NotImplementedError(
+                "1-bit optimizers are incompatible with ZeRO sharding "
+                "(reference constraint): set zero_optimization.stage=0")
+        if self._config.fp16.enabled:
+            raise NotImplementedError("1-bit compressed stage requires bf16/fp32")
+        for ax in ("tensor", "pipe", "seq", "expert", "zrep"):
+            if self.mesh.shape.get(ax, 1) > 1:
+                raise NotImplementedError(
+                    f"1-bit compressed comm supports a pure data mesh (got {ax}>1)")
+        self._onebit_freeze_step = int(self.optimizer.hyper.get("freeze_step", 100_000))
+        self._onebit_errors = None
+        self._onebit_fn = None
+
+    def _init_onebit_errors(self):
+        n = self.mesh.shape["data"]
+        spec_w = {}
+
+        def alloc(p):
+            chunk = (int(np.prod(p.shape)) + n - 1) // n
+            return {"worker": jnp.zeros((n,) + tuple(p.shape), jnp.float32),
+                    "server": jnp.zeros((n, chunk), jnp.float32)}
+
+        errors = jax.tree.map(alloc, self.module_params)
+        sh = NamedSharding(self.mesh, P("data"))
+        return jax.device_put(errors, jax.tree.map(
+            lambda _: sh, errors, is_leaf=lambda x: isinstance(x, jnp.ndarray)))
+
+    def _compile_onebit_compressed_fn(self):
+        from .comm.compressed import compressed_allreduce_body
+        hyper = self.optimizer.hyper
+        b1, _b2 = hyper["betas"]
+        eps = float(hyper["eps"])
+        wd = float(hyper.get("weight_decay", 0.0))
+        mesh = self.mesh
+
+        @functools.partial(
+            jax.jit, donate_argnums=(0, 1, 2), static_argnames=("gas",),
+            out_shardings=(self.param_shardings, self.opt_state_shardings,
+                           None, self._replicated))
+        def comp_step(params, opt_state, errors, batch, lr, gas):
+            flat_p, treedef = jax.tree.flatten(params)
+            flat_m = treedef.flatten_up_to(
+                jax.tree.map(lambda s: s["m"], opt_state["slots"],
+                             is_leaf=lambda x: isinstance(x, dict) and "m" in x))
+            flat_err = treedef.flatten_up_to(errors)
+            step = opt_state["step"] + 1
+
+            batch_specs = jax.tree.map(lambda _: P(None, "data"), batch)
+            err_specs = treedef.unflatten([{"worker": P("data"), "server": P("data")}
+                                           for _ in flat_p])
+
+            def body(params_, ms, errs, batch_local, lr_, step_):
+                def micro(carry, mb):
+                    acc, ls = carry
+                    loss, g = jax.value_and_grad(self.model.loss)(params_, mb)
+                    return (jax.tree.map(jnp.add, acc,
+                                         jax.tree.map(lambda x: x.astype(jnp.float32), g)),
+                            ls + loss), None
+
+                acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params_)
+                (acc, loss_sum), _ = jax.lax.scan(
+                    micro, (acc0, jnp.zeros((), jnp.float32)), batch_local)
+                g_local = jax.tree.map(lambda g: g / gas, acc)
+                flat_g = treedef.flatten_up_to(g_local)
+                flat_e = treedef.flatten_up_to(errs)
+
+                new_m, new_err = [], []
+                n = jax.lax.axis_size("data")
+                for m, g, e in zip(ms, flat_g, flat_e):
+                    m_local = b1 * m + (1 - b1) * g
+                    m_sum, we, se = compressed_allreduce_body(
+                        m_local, e["worker"][0], e["server"][0], "data")
+                    new_m.append(m_sum / n)   # compressed allreduce sums
+                    new_err.append({"worker": we[None], "server": se[None]})
+                return (new_m, treedef.unflatten(new_err),
+                        jax.lax.pmean(loss_sum / gas, "data"))
+
+            fn = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), [P()] * len(flat_m), err_specs, batch_specs, P(), P()),
+                out_specs=([P()] * len(flat_m), err_specs, P()),
+                axis_names={"data"}, check_vma=False)
+            new_m, new_errors, loss = fn(params, flat_m, errors, batch,
+                                         lr, step.astype(jnp.float32))
+
+            # Adam update with compressed momentum, frozen variance
+            # (reference onebit/adam.py compressed stage)
+            flat_v = treedef.flatten_up_to(
+                jax.tree.map(lambda s: s["v"], opt_state["slots"],
+                             is_leaf=lambda x: isinstance(x, dict) and "m" in x))
+            new_p = []
+            for p, m, v in zip(flat_p, new_m, flat_v):
+                p32 = p.astype(jnp.float32)
+                # no bias correction in the compressed stage (reference
+                # onebit/adam.py: update = exp_avg / (sqrt(exp_avg_sq)+eps))
+                upd = m / (jnp.sqrt(v) + eps)
+                if wd:
+                    upd = upd + wd * p32
+                new_p.append((p32 - lr * upd).astype(p.dtype))
+
+            flat_slots = treedef.flatten_up_to(opt_state["slots"])
+            new_slots = []
+            for s, m in zip(flat_slots, new_m):
+                ns = dict(s)
+                ns["m"] = m
+                new_slots.append(ns)
+            new_state = {"step": step, "slots": treedef.unflatten(new_slots)}
+            return treedef.unflatten(new_p), new_state, new_errors, loss
+
+        return comp_step
+
+    def _onebit_compressed_train_batch(self, batch):
+        if self._onebit_errors is None:
+            self._onebit_errors = self._init_onebit_errors()
+            log_dist(f"1-bit {self.optimizer.name}: entering COMPRESSED stage at "
+                     f"step {self.global_steps + 1}", ranks=[0])
+        if self._onebit_fn is None:
+            self._onebit_fn = self._compile_onebit_compressed_fn()
+        gas = self.gradient_accumulation_steps()
+        batch = jax.tree.map(self._stage_leaf, batch)
+        self.tput_timer.start()
+        lr = self._next_lr_device()
+        (self.module_params, self.opt_state, self._onebit_errors,
+         loss) = self._onebit_fn(self.module_params, self.opt_state,
+                                 self._onebit_errors, batch, lr, gas=gas)
+        self.micro_steps += gas
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self._post_step(jnp.zeros((), jnp.bool_), None, loss)
+        self.tput_timer.stop(global_step=True)
+        return loss
 
     def _compile_host_offload_step_fns(self):
         """Device side of the native ZeRO-Offload step: accumulate fp32
@@ -857,6 +1001,9 @@ class DeepSpeedEngine:
             return loss
         if self._host_optimizer is not None:
             return self._host_offload_train_batch(batch)
+        if getattr(self, "_onebit", False) and \
+                self.global_steps + 1 > self._onebit_freeze_step:
+            return self._onebit_compressed_train_batch(batch)
         gas = self.gradient_accumulation_steps()
         batch = jax.tree.map(self._stage_leaf, batch)
         self.tput_timer.start()
