@@ -1,0 +1,10 @@
+"""v2 model implementations: declarative checkpoint containers per arch.
+
+Analog of ``deepspeed/inference/v2/model_implementations/``.
+"""
+
+from .archs import (ARCH_CONTAINERS, GPT2Container, LlamaContainer,
+                    MistralContainer, MixtralContainer, OPTContainer,
+                    Phi3Container, Qwen2Container, build_native,
+                    resolve_container)
+from .layer_container import LayerContainer, Param

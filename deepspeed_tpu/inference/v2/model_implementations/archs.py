@@ -1,0 +1,291 @@
+"""Per-architecture model implementations (checkpoint containers).
+
+Analog of ``inference/v2/model_implementations/{llama_v2,mistral,mixtral,
+qwen_v2,phi3,opt,...}.py``: each class binds an HF architecture to (a) the
+native ``TransformerConfig`` derived from its HF config and (b) the
+declarative weight mapping (``LayerContainer``) that loads its checkpoint
+into the scan-ready native layout. ``resolve_container`` dispatches on the
+HF architecture string; ``build_native`` is the one-call path used by
+``build_hf_engine`` and ``module_inject``.
+"""
+
+from typing import Dict, Tuple, Type
+
+import numpy as np
+
+from ....models.config import TransformerConfig
+from ....models.transformer import CausalLM
+from .layer_container import (LayerContainer, Param, t_identity, t_kv_bias,
+                              t_kv_heads, t_linear, t_o_heads, t_q_bias,
+                              t_q_heads)
+
+
+def _get(hf_cfg, *names, default=None):
+    for n in names:
+        v = getattr(hf_cfg, n, None)
+        if v is not None:
+            return v
+    return default
+
+
+def _llama_family_config(hf_cfg, **overrides) -> TransformerConfig:
+    kw = dict(
+        vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+        num_layers=_get(hf_cfg, "num_hidden_layers", "n_layer"),
+        num_heads=_get(hf_cfg, "num_attention_heads", "n_head"),
+        num_kv_heads=_get(hf_cfg, "num_key_value_heads"),
+        intermediate_size=_get(hf_cfg, "intermediate_size"),
+        max_seq_len=_get(hf_cfg, "max_position_embeddings", default=4096),
+        rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
+        norm_eps=float(_get(hf_cfg, "rms_norm_eps", "layer_norm_epsilon",
+                            default=1e-5)),
+        tie_embeddings=bool(_get(hf_cfg, "tie_word_embeddings", default=False)))
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+class LlamaContainer(LayerContainer):
+    """Llama v2/v3 (reference ``model_implementations/llama_v2``)."""
+
+    layer_mapping = {
+        "attn.wq": Param("model.layers.{l}.self_attn.q_proj.weight", t_q_heads),
+        "attn.wk": Param("model.layers.{l}.self_attn.k_proj.weight", t_kv_heads),
+        "attn.wv": Param("model.layers.{l}.self_attn.v_proj.weight", t_kv_heads),
+        "attn.wo": Param("model.layers.{l}.self_attn.o_proj.weight", t_o_heads),
+        "norm1.scale": Param("model.layers.{l}.input_layernorm.weight"),
+        "norm2.scale": Param("model.layers.{l}.post_attention_layernorm.weight"),
+        "mlp.wi_gate": Param("model.layers.{l}.mlp.gate_proj.weight", t_linear),
+        "mlp.wi_up": Param("model.layers.{l}.mlp.up_proj.weight", t_linear),
+        "mlp.wo": Param("model.layers.{l}.mlp.down_proj.weight", t_linear),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("model.embed_tokens.weight"),
+        "embed.lm_head": Param("lm_head.weight", t_linear, optional=True),
+        "final_norm.scale": Param("model.norm.weight"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return _llama_family_config(hf_cfg)
+
+
+class MistralContainer(LlamaContainer):
+    """Mistral shares Llama's graph (reference ``mistral/container.py``)."""
+
+
+class MixtralContainer(LlamaContainer):
+    """Mixtral MoE (reference ``mixtral/container.py``)."""
+
+    layer_mapping = {
+        **{k: v for k, v in LlamaContainer.layer_mapping.items()
+           if not k.startswith("mlp.")},
+        "mlp.router": Param("model.layers.{l}.block_sparse_moe.gate.weight", t_linear),
+        "mlp.wi_gate": Param(
+            "model.layers.{l}.block_sparse_moe.experts.{x}.w1.weight", t_linear),
+        "mlp.wi_up": Param(
+            "model.layers.{l}.block_sparse_moe.experts.{x}.w3.weight", t_linear),
+        "mlp.wo": Param(
+            "model.layers.{l}.block_sparse_moe.experts.{x}.w2.weight", t_linear),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return _llama_family_config(
+            hf_cfg,
+            num_experts=int(_get(hf_cfg, "num_local_experts", "num_experts",
+                                 default=8)),
+            num_experts_per_tok=int(_get(hf_cfg, "num_experts_per_tok", default=2)))
+
+
+class Qwen2Container(LlamaContainer):
+    """Qwen2 = Llama graph + q/k/v biases (reference ``qwen_v2``)."""
+
+    layer_mapping = {
+        **LlamaContainer.layer_mapping,
+        "attn.bq": Param("model.layers.{l}.self_attn.q_proj.bias", t_q_bias),
+        "attn.bk": Param("model.layers.{l}.self_attn.k_proj.bias", t_kv_bias),
+        "attn.bv": Param("model.layers.{l}.self_attn.v_proj.bias", t_kv_bias),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return _llama_family_config(hf_cfg, qkv_bias=True)
+
+
+def _t_phi3_q(w, cfg):
+    q = w[: cfg.num_heads * cfg.dims_per_head]
+    return q.T.reshape(cfg.hidden_size, cfg.num_heads, cfg.dims_per_head)
+
+
+def _t_phi3_k(w, cfg):
+    h, kvh, d = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+    k = w[h * d:(h + kvh) * d]
+    return k.T.reshape(cfg.hidden_size, kvh, d)
+
+
+def _t_phi3_v(w, cfg):
+    h, kvh, d = cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
+    v = w[(h + kvh) * d:]
+    return v.T.reshape(cfg.hidden_size, kvh, d)
+
+
+def _t_phi3_gate(w, cfg):
+    return w[: cfg.ffn_size].T
+
+
+def _t_phi3_up(w, cfg):
+    return w[cfg.ffn_size:].T
+
+
+class Phi3Container(LlamaContainer):
+    """Phi-3: fused qkv_proj / gate_up_proj split on load (reference
+    ``phi3/containers.py``)."""
+
+    layer_mapping = {
+        "attn.wq": Param("model.layers.{l}.self_attn.qkv_proj.weight", _t_phi3_q),
+        "attn.wk": Param("model.layers.{l}.self_attn.qkv_proj.weight", _t_phi3_k),
+        "attn.wv": Param("model.layers.{l}.self_attn.qkv_proj.weight", _t_phi3_v),
+        "attn.wo": Param("model.layers.{l}.self_attn.o_proj.weight", t_o_heads),
+        "norm1.scale": Param("model.layers.{l}.input_layernorm.weight"),
+        "norm2.scale": Param("model.layers.{l}.post_attention_layernorm.weight"),
+        "mlp.wi_gate": Param("model.layers.{l}.mlp.gate_up_proj.weight", _t_phi3_gate),
+        "mlp.wi_up": Param("model.layers.{l}.mlp.gate_up_proj.weight", _t_phi3_up),
+        "mlp.wo": Param("model.layers.{l}.mlp.down_proj.weight", t_linear),
+    }
+
+
+def _t_opt_pos(w, cfg):
+    return w  # offset handled by cfg.position_offset at lookup time
+
+
+class OPTContainer(LayerContainer):
+    """OPT (reference ``opt/container.py``): learned positions offset by 2,
+    pre-LN layernorm with biases, relu MLP, tied embeddings."""
+
+    layer_mapping = {
+        "attn.wq": Param("model.decoder.layers.{l}.self_attn.q_proj.weight", t_q_heads),
+        "attn.wk": Param("model.decoder.layers.{l}.self_attn.k_proj.weight", t_kv_heads),
+        "attn.wv": Param("model.decoder.layers.{l}.self_attn.v_proj.weight", t_kv_heads),
+        "attn.wo": Param("model.decoder.layers.{l}.self_attn.out_proj.weight", t_o_heads),
+        "attn.bq": Param("model.decoder.layers.{l}.self_attn.q_proj.bias", t_q_bias),
+        "attn.bk": Param("model.decoder.layers.{l}.self_attn.k_proj.bias", t_kv_bias),
+        "attn.bv": Param("model.decoder.layers.{l}.self_attn.v_proj.bias", t_kv_bias),
+        "attn.bo": Param("model.decoder.layers.{l}.self_attn.out_proj.bias"),
+        "norm1.scale": Param("model.decoder.layers.{l}.self_attn_layer_norm.weight"),
+        "norm1.bias": Param("model.decoder.layers.{l}.self_attn_layer_norm.bias"),
+        "norm2.scale": Param("model.decoder.layers.{l}.final_layer_norm.weight"),
+        "norm2.bias": Param("model.decoder.layers.{l}.final_layer_norm.bias"),
+        "mlp.wi": Param("model.decoder.layers.{l}.fc1.weight", t_linear),
+        "mlp.bi": Param("model.decoder.layers.{l}.fc1.bias"),
+        "mlp.wo": Param("model.decoder.layers.{l}.fc2.weight", t_linear),
+        "mlp.bo": Param("model.decoder.layers.{l}.fc2.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("model.decoder.embed_tokens.weight"),
+        "embed.pos": Param("model.decoder.embed_positions.weight", _t_opt_pos),
+        "final_norm.scale": Param("model.decoder.final_layer_norm.weight"),
+        "final_norm.bias": Param("model.decoder.final_layer_norm.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers, num_heads=hf_cfg.num_attention_heads,
+            intermediate_size=hf_cfg.ffn_dim,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            activation="relu", norm="layernorm", position="learned",
+            position_offset=2, use_bias=True, tie_embeddings=True,
+            norm_eps=1e-5)
+
+
+def _t_gpt2_qkv(idx):
+    def t(w, cfg):
+        e = cfg.hidden_size
+        part = w[:, idx * e:(idx + 1) * e]  # Conv1D weights are (in, out)
+        return part.reshape(e, cfg.num_heads, cfg.dims_per_head)
+    return t
+
+
+def _t_gpt2_qkv_bias(idx):
+    def t(b, cfg):
+        e = cfg.hidden_size
+        return b[idx * e:(idx + 1) * e].reshape(cfg.num_heads, cfg.dims_per_head)
+    return t
+
+
+def _t_gpt2_o(w, cfg):
+    return w.reshape(cfg.num_heads, cfg.dims_per_head, cfg.hidden_size)
+
+
+class GPT2Container(LayerContainer):
+    """GPT-2 (Conv1D (in, out) weights; fused c_attn split on load)."""
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.h.{l}.attn.c_attn.weight", _t_gpt2_qkv(0)),
+        "attn.wk": Param("transformer.h.{l}.attn.c_attn.weight", _t_gpt2_qkv(1)),
+        "attn.wv": Param("transformer.h.{l}.attn.c_attn.weight", _t_gpt2_qkv(2)),
+        "attn.bq": Param("transformer.h.{l}.attn.c_attn.bias", _t_gpt2_qkv_bias(0)),
+        "attn.bk": Param("transformer.h.{l}.attn.c_attn.bias", _t_gpt2_qkv_bias(1)),
+        "attn.bv": Param("transformer.h.{l}.attn.c_attn.bias", _t_gpt2_qkv_bias(2)),
+        "attn.wo": Param("transformer.h.{l}.attn.c_proj.weight", _t_gpt2_o),
+        "attn.bo": Param("transformer.h.{l}.attn.c_proj.bias"),
+        "norm1.scale": Param("transformer.h.{l}.ln_1.weight"),
+        "norm1.bias": Param("transformer.h.{l}.ln_1.bias"),
+        "norm2.scale": Param("transformer.h.{l}.ln_2.weight"),
+        "norm2.bias": Param("transformer.h.{l}.ln_2.bias"),
+        "mlp.wi": Param("transformer.h.{l}.mlp.c_fc.weight"),
+        "mlp.bi": Param("transformer.h.{l}.mlp.c_fc.bias"),
+        "mlp.wo": Param("transformer.h.{l}.mlp.c_proj.weight"),
+        "mlp.bo": Param("transformer.h.{l}.mlp.c_proj.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("transformer.wte.weight"),
+        "embed.pos": Param("transformer.wpe.weight"),
+        "final_norm.scale": Param("transformer.ln_f.weight"),
+        "final_norm.bias": Param("transformer.ln_f.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.n_embd,
+            num_layers=hf_cfg.n_layer, num_heads=hf_cfg.n_head,
+            intermediate_size=4 * hf_cfg.n_embd, max_seq_len=hf_cfg.n_positions,
+            activation="gelu", norm="layernorm", position="learned",
+            tie_embeddings=True, use_bias=True,
+            norm_eps=hf_cfg.layer_norm_epsilon)
+
+
+ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
+    "llama": LlamaContainer,
+    "mistral": MistralContainer,
+    "mixtral": MixtralContainer,
+    "qwen2moe": MixtralContainer,   # qwen2-moe shares the expert layout
+    "qwen2": Qwen2Container,
+    "phi3": Phi3Container,
+    "opt": OPTContainer,
+    "gpt2": GPT2Container,
+}
+
+
+def resolve_container(hf_cfg) -> Type[LayerContainer]:
+    arch = (getattr(hf_cfg, "architectures", None) or [type(hf_cfg).__name__])[0].lower()
+    # longest-match so "qwen2moe" wins over "qwen2"
+    for key in sorted(ARCH_CONTAINERS, key=len, reverse=True):
+        if key in arch.replace("_", ""):
+            return ARCH_CONTAINERS[key]
+    raise NotImplementedError(
+        f"no v2 model implementation for architecture {arch!r}; "
+        f"known: {sorted(ARCH_CONTAINERS)}")
+
+
+def build_native(hf_model, dtype: str = None) -> Tuple[CausalLM, Dict]:
+    """HF model instance → (native CausalLM, scan-ready param pytree)."""
+    container = resolve_container(hf_model.config)
+    cfg = container.config(hf_model.config)
+    if dtype:
+        cfg = cfg.replace(dtype=dtype)
+    sd = hf_model.state_dict()
+    params = container.build_params(sd, cfg)
+    return CausalLM(cfg), params

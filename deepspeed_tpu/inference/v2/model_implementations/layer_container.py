@@ -1,0 +1,139 @@
+"""Declarative HF-checkpoint → native-param mapping.
+
+Analog of ``inference/v2/model_implementations/layer_container_base.py`` +
+``parameter_base.py``: a LayerContainer lists, per transformer layer, which
+source tensor feeds each native parameter slot and how it is transformed
+(transpose, head split, fused-weight slicing, expert stacking). The base
+class walks the mapping for every layer and stacks the results into the
+scan-ready (L, ...) layout the compiled models consume.
+
+Transforms receive (numpy array, TransformerConfig) and return the native
+layout; ``Param`` entries may reference multiple source tensors (fused
+weights) or per-expert template names.
+"""
+
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ....models.config import TransformerConfig
+
+
+def _np(t):
+    try:
+        return t.detach().cpu().numpy()
+    except AttributeError:
+        return np.asarray(t)
+
+
+# ---- standard transforms -------------------------------------------------
+
+def t_linear(w, cfg):
+    """HF (out, in) → native (in, out)."""
+    return w.T
+
+
+def t_q_heads(w, cfg):
+    return w.T.reshape(cfg.hidden_size, cfg.num_heads, cfg.dims_per_head)
+
+
+def t_kv_heads(w, cfg):
+    return w.T.reshape(cfg.hidden_size, cfg.kv_heads, cfg.dims_per_head)
+
+
+def t_o_heads(w, cfg):
+    return w.T.reshape(cfg.num_heads, cfg.dims_per_head, cfg.hidden_size)
+
+
+def t_q_bias(b, cfg):
+    return b.reshape(cfg.num_heads, cfg.dims_per_head)
+
+
+def t_kv_bias(b, cfg):
+    return b.reshape(cfg.kv_heads, cfg.dims_per_head)
+
+
+def t_identity(w, cfg):
+    return w
+
+
+class Param:
+    """One native slot: source name template(s) + transform.
+
+    ``src`` templates may use ``{l}`` (layer index) and ``{x}`` (expert
+    index; presence marks an expert-stacked parameter). Multiple sources are
+    passed to the transform as a list (fused-weight splitting).
+    """
+
+    def __init__(self, src: Union[str, Sequence[str]],
+                 transform: Callable = t_identity, optional: bool = False):
+        self.srcs = [src] if isinstance(src, str) else list(src)
+        self.transform = transform
+        self.optional = optional
+
+    def materialize(self, sd, cfg, l: int, num_experts: int = 0):
+        def one(fmt, x=None):
+            name = fmt.format(l=l, x=x)
+            if name not in sd:
+                if self.optional:
+                    return None
+                raise KeyError(f"checkpoint missing tensor {name!r}")
+            return _np(sd[name])
+
+        expert_stacked = any("{x}" in s for s in self.srcs)
+        if expert_stacked:
+            per_expert = []
+            for x in range(num_experts):
+                vals = [one(s, x) for s in self.srcs]
+                if any(v is None for v in vals):
+                    return None
+                v = vals[0] if len(vals) == 1 else vals
+                per_expert.append(self.transform(v, cfg))
+            return np.stack(per_expert)
+        vals = [one(s) for s in self.srcs]
+        if any(v is None for v in vals):
+            return None
+        v = vals[0] if len(vals) == 1 else vals
+        return self.transform(v, cfg)
+
+
+class LayerContainer:
+    """Per-layer mapping plus the non-layer (embed/head/final-norm) table.
+
+    Subclasses define ``layer_mapping`` (native dotted path → Param) and
+    ``non_layer_mapping`` (same, ``{l}``-free), plus ``config(hf_cfg)``.
+    """
+
+    layer_mapping: Dict[str, Param] = {}
+    non_layer_mapping: Dict[str, Param] = {}
+
+    @classmethod
+    def config(cls, hf_cfg) -> TransformerConfig:
+        raise NotImplementedError
+
+    @staticmethod
+    def _set(tree, dotted: str, value):
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            tree = tree.setdefault(p, {})
+        tree[parts[-1]] = value
+
+    @classmethod
+    def build_params(cls, sd, cfg: TransformerConfig):
+        """Walk the mapping for every layer, stack to (L, ...) trees."""
+        per_layer: Dict[str, List[np.ndarray]] = {k: [] for k in cls.layer_mapping}
+        for l in range(cfg.num_layers):
+            for path, param in cls.layer_mapping.items():
+                v = param.materialize(sd, cfg, l, cfg.num_experts)
+                if v is not None:
+                    per_layer[path].append(v)
+        layers: Dict = {}
+        for path, vals in per_layer.items():
+            if vals:
+                cls._set(layers, path, np.stack(vals))
+        out: Dict = {"layers": layers}
+        for path, param in cls.non_layer_mapping.items():
+            v = param.materialize(sd, cfg, 0, cfg.num_experts)
+            if v is not None:
+                cls._set(out, path, v)
+        return out

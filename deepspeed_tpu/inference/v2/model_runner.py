@@ -61,8 +61,9 @@ class PagedModelRunner:
         b, c = ids.shape
         h = params["embed"]["tok"].astype(dt)[ids]
         if cfg.position == "learned":
-            h = h + params["embed"]["pos"].astype(dt)[jnp.clip(positions, 0,
-                                                               cfg.max_seq_len - 1)]
+            h = h + params["embed"]["pos"].astype(dt)[
+                jnp.clip(positions + cfg.position_offset, 0,
+                         params["embed"]["pos"].shape[0] - 1)]
         inv_freq = model._inv_freq
         b_idx = jnp.arange(b)[:, None]                      # (B, 1)
         # positions < 0 mark padding: route their writes to trash block 0

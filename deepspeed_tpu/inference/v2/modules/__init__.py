@@ -1,0 +1,12 @@
+"""v2 per-op module layer: configs + registry + default implementations.
+
+Analog of ``deepspeed/inference/v2/modules/`` (interfaces, registry,
+implementations, configs).
+"""
+
+from .configs import (DSEmbeddingsConfig, DSLinearConfig, DSMoEConfig,
+                      DSNormConfig, DSSelfAttentionConfig, DSUnembedConfig)
+from .registry import (ConfigBundle, available, instantiate, register_module,
+                       OP_ATTENTION, OP_EMBEDDING, OP_LINEAR, OP_MOE,
+                       OP_POST_NORM, OP_PRE_NORM, OP_UNEMBED)
+from . import implementations  # noqa: F401  (self-registers defaults)

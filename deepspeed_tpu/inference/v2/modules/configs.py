@@ -1,0 +1,73 @@
+"""Per-op module configs for the v2 inference stack.
+
+Analog of ``inference/v2/modules/configs/`` (DSSelfAttentionConfig,
+DSEmbeddingsConfig, DSLinearConfig, DSMoEConfig, DSNormConfig,
+DSUnembedConfig): small declarative records each implementation is built
+from. Dataclasses instead of torch-bound config objects; dtypes are jnp
+dtypes.
+"""
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclass
+class DSEmbeddingsConfig:
+    vocab_size: int = 0
+    hidden_size: int = 0
+    max_seq_len: int = 0
+    positional: str = "none"          # "none" | "learned" | "rope"
+    position_offset: int = 0          # OPT uses learned positions offset by 2
+    dtype: object = jnp.bfloat16
+
+
+@dataclass
+class DSSelfAttentionConfig:
+    num_heads: int = 0
+    num_kv_heads: Optional[int] = None
+    head_dim: int = 0
+    scale: Optional[float] = None
+    rope_theta: float = 10000.0
+    use_rope: bool = True
+    block_size: int = 16              # KV page size
+    dtype: object = jnp.bfloat16
+
+
+@dataclass
+class DSLinearConfig:
+    in_features: int = 0
+    out_features: int = 0
+    bias: bool = False
+    activation: str = "identity"      # "identity" | "gelu" | "silu" | "swiglu" | "gegelu"
+    quantize: Optional[str] = None    # None | "int8" | "int4"
+    dtype: object = jnp.bfloat16
+
+
+@dataclass
+class DSNormConfig:
+    hidden_size: int = 0
+    type: str = "rmsnorm"             # "rmsnorm" | "layernorm"
+    eps: float = 1e-5
+    dtype: object = jnp.bfloat16
+
+
+@dataclass
+class DSMoEConfig:
+    num_experts: int = 0
+    top_k: int = 2
+    hidden_size: int = 0
+    intermediate_size: int = 0
+    impl: str = "grouped"             # "grouped" | "einsum"
+    capacity_factor: float = 1.25
+    dtype: object = jnp.bfloat16
+
+
+@dataclass
+class DSUnembedConfig:
+    vocab_size: int = 0
+    hidden_size: int = 0
+    norm: Optional[DSNormConfig] = None
+    tie_embeddings: bool = False
+    dtype: object = jnp.bfloat16

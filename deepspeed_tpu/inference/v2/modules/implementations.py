@@ -1,0 +1,204 @@
+"""Default v2 module implementations.
+
+Analog of ``inference/v2/modules/implementations/`` (the CUDA module set:
+blocked-flash attention, rotary embeddings, cuBLAS/CUTLASS linears, fused
+norms, MoE gather/scatter/GEMM, logits gather). Each builder wraps the
+TPU-native kernel already used by the production path — Pallas paged
+attention, XLA-fused norms/activations, ragged-dot MoE, int8/int4
+weight-only linear — so a model assembled from the registry and the
+hand-built ``PagedModelRunner`` layer run the same code.
+
+Modules are pure functions over explicit param pytrees (see
+``registry.py``); the builder returns ``fn`` and documents the param
+structure it expects.
+"""
+
+import jax
+import jax.numpy as jnp
+
+from .configs import (DSEmbeddingsConfig, DSLinearConfig, DSMoEConfig,
+                      DSNormConfig, DSSelfAttentionConfig, DSUnembedConfig)
+from .registry import (OP_ATTENTION, OP_EMBEDDING, OP_LINEAR, OP_MOE,
+                       OP_POST_NORM, OP_PRE_NORM, OP_UNEMBED, register_module)
+
+
+# ---- norms ---------------------------------------------------------------
+
+def _norm_fn(cfg: DSNormConfig):
+    def fn(params, x):
+        x32 = x.astype(jnp.float32)
+        if cfg.type == "rmsnorm":
+            var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+            y = x32 * jax.lax.rsqrt(var + cfg.eps) * params["scale"].astype(jnp.float32)
+        else:
+            mean = jnp.mean(x32, axis=-1, keepdims=True)
+            var = jnp.var(x32, axis=-1, keepdims=True)
+            y = (x32 - mean) * jax.lax.rsqrt(var + cfg.eps)
+            y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype)
+
+    return fn
+
+
+@register_module(OP_PRE_NORM, "fused_norm")
+def build_pre_norm(cfg: DSNormConfig):
+    """params: {"scale"[, "bias"]}; fn(params, residual) -> normed."""
+    return _norm_fn(cfg)
+
+
+@register_module(OP_POST_NORM, "fused_norm")
+def build_post_norm(cfg: DSNormConfig):
+    """fn(params, residual, x) -> norm(residual + x)."""
+    norm = _norm_fn(cfg)
+
+    def fn(params, residual, x):
+        return norm(params, residual + x)
+
+    return fn
+
+
+# ---- linear --------------------------------------------------------------
+
+_ACTS = {
+    "identity": lambda x: x,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "relu": jax.nn.relu,
+}
+
+
+@register_module(OP_LINEAR, "blas_fp")
+def build_linear(cfg: DSLinearConfig):
+    """params: {"w": (in, out)[, "b"]}; swiglu/gegelu expect
+    {"w_gate", "w_up"} and fuse act(x@w_gate) * (x@w_up)."""
+    dt = cfg.dtype
+
+    if cfg.activation in ("swiglu", "gegelu"):
+        act = jax.nn.silu if cfg.activation == "swiglu" else jax.nn.gelu
+
+        def gated(params, x):
+            g = x @ params["w_gate"].astype(dt)
+            u = x @ params["w_up"].astype(dt)
+            return act(g) * u
+
+        return gated
+
+    act = _ACTS[cfg.activation]
+
+    def fn(params, x):
+        y = x @ params["w"].astype(dt)
+        if cfg.bias and "b" in params:
+            y = y + params["b"].astype(dt)
+        return act(y)
+
+    return fn
+
+
+@register_module(OP_LINEAR, "quantized_wo")
+def build_quantized_linear(cfg: DSLinearConfig):
+    """Weight-only int8/int4 linear (analog of the FP6/INT4 mixed-input
+    GEMM, ``inference/v2/kernels/core_ops/cuda_linear``): params hold a
+    pre-quantized table from ``inference.quantization.layers``."""
+    from ...quantization.layers import QuantizedParameter
+    act = _ACTS.get(cfg.activation, _ACTS["identity"])
+
+    def fn(params, x):
+        qp: QuantizedParameter = params["qw"]
+        y = x @ qp.dequantized().astype(cfg.dtype)
+        if cfg.bias and "b" in params:
+            y = y + params["b"].astype(cfg.dtype)
+        return act(y)
+
+    return fn
+
+
+# ---- embedding -----------------------------------------------------------
+
+@register_module(OP_EMBEDDING, "ragged_embed")
+def build_embedding(cfg: DSEmbeddingsConfig):
+    """params: {"tok": (V, E)[, "pos": (S, E)]}; fn(params, ids, positions)."""
+
+    def fn(params, ids, positions):
+        h = params["tok"].astype(cfg.dtype)[ids]
+        if cfg.positional == "learned":
+            pos = jnp.clip(positions + cfg.position_offset, 0,
+                           params["pos"].shape[0] - 1)
+            h = h + params["pos"].astype(cfg.dtype)[pos]
+        return h
+
+    return fn
+
+
+# ---- attention -----------------------------------------------------------
+
+@register_module(OP_ATTENTION, "paged_flash")
+def build_paged_attention(cfg: DSSelfAttentionConfig):
+    """Decode attention over in-place KV pages (Pallas kernel, analog of
+    blocked-flash): fn(q, kpool, vpool, block_tables, seq_lens) with
+    q (B, H, D), pools (KVH, NB, bs, D)."""
+    from ....ops.pallas.paged_attention import paged_decode_attention
+
+    def fn(q, kpool, vpool, block_tables, seq_lens):
+        return paged_decode_attention(q, kpool, vpool, block_tables, seq_lens,
+                                      scale=cfg.scale)
+
+    return fn
+
+
+@register_module(OP_ATTENTION, "dense_flash")
+def build_dense_attention(cfg: DSSelfAttentionConfig):
+    """Training/prefill-style dense flash attention: fn(q, k, v) with
+    (B, S, H, D) tensors, causal."""
+    from ....ops.attention import multihead_attention
+
+    def fn(q, k, v, segment_ids=None):
+        return multihead_attention(q, k, v, causal=True, segment_ids=segment_ids,
+                                   scale=cfg.scale)
+
+    return fn
+
+
+# ---- MoE -----------------------------------------------------------------
+
+@register_module(OP_MOE, "ragged_moe")
+def build_moe(cfg: DSMoEConfig):
+    """params: {"router", "wi_gate", "wi_up", "wo"} (expert-stacked);
+    fn(params, x) -> (y, aux). Grouped (sort + ragged_dot) or capacity
+    einsum dispatch per ``cfg.impl`` — the same code MoE training uses."""
+    from ....models.config import TransformerConfig
+    from ....models.layers import apply_moe_grouped, apply_moe_mlp
+
+    mcfg = TransformerConfig(
+        vocab_size=1, hidden_size=cfg.hidden_size, num_layers=1, num_heads=1,
+        intermediate_size=cfg.intermediate_size, max_seq_len=1,
+        num_experts=cfg.num_experts, num_experts_per_tok=cfg.top_k,
+        moe_capacity_factor=cfg.capacity_factor, moe_impl=cfg.impl,
+        dtype="bfloat16" if cfg.dtype == jnp.bfloat16 else "float32")
+
+    def fn(params, x):
+        if cfg.impl == "grouped":
+            return apply_moe_grouped(params, x, mcfg)
+        return apply_moe_mlp(params, x, mcfg)
+
+    return fn
+
+
+# ---- unembed -------------------------------------------------------------
+
+@register_module(OP_UNEMBED, "logits_gather")
+def build_unembed(cfg: DSUnembedConfig):
+    """Final norm + LM head on LAST tokens only (reference logits_gather —
+    only each sequence's last position pays the (E, V) matmul):
+    fn(params, h_last) with h_last (B, E) → (B, V) fp32 logits.
+    params: {"final_norm", "embed": {"tok"[, "lm_head"]}}."""
+    norm = _norm_fn(cfg.norm) if cfg.norm is not None else None
+
+    def fn(params, h_last):
+        h = norm(params["final_norm"], h_last) if norm is not None else h_last
+        if cfg.tie_embeddings:
+            w = params["embed"]["tok"].astype(h.dtype)
+            return (h @ w.T).astype(jnp.float32)
+        w = params["embed"]["lm_head"].astype(h.dtype)
+        return (h @ w).astype(jnp.float32)
+
+    return fn

@@ -1,0 +1,58 @@
+"""Module registry for the v2 inference stack.
+
+Analog of ``inference/v2/modules/module_registry.py`` + the
+``DSModuleRegistryBase`` pattern: implementations self-register under an
+(op_type, impl_name) key; a ``ConfigBundle`` names the implementation and
+carries its config; ``instantiate`` resolves and builds.
+
+TPU-first shape: a "module" is a BUILDER returning a pure function
+``fn(params, *inputs) -> outputs`` (plus an optional param-spec pytree for
+allocation/validation) — composable under jit, no stateful objects in the
+compiled path.
+"""
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional
+
+OP_ATTENTION = "attention"
+OP_EMBEDDING = "embedding"
+OP_LINEAR = "linear"
+OP_PRE_NORM = "pre_norm"
+OP_POST_NORM = "post_norm"
+OP_MOE = "moe"
+OP_UNEMBED = "unembed"
+
+_REGISTRY: Dict[str, Dict[str, Callable]] = {}
+
+
+def register_module(op_type: str, name: str):
+    """Class/function decorator: register a builder under (op_type, name)."""
+
+    def deco(builder):
+        _REGISTRY.setdefault(op_type, {})[name] = builder
+        return builder
+
+    return deco
+
+
+def available(op_type: Optional[str] = None):
+    if op_type is None:
+        return {k: sorted(v) for k, v in _REGISTRY.items()}
+    return sorted(_REGISTRY.get(op_type, {}))
+
+
+@dataclass
+class ConfigBundle:
+    """(implementation name, config) pair — reference ConfigBundle."""
+    name: str
+    config: Any
+
+
+def instantiate(op_type: str, bundle: ConfigBundle):
+    """Resolve and build: returns whatever the builder returns (a callable
+    module function). Raises KeyError with the known set on a miss."""
+    impls = _REGISTRY.get(op_type, {})
+    if bundle.name not in impls:
+        raise KeyError(f"no {op_type!r} implementation named {bundle.name!r}; "
+                       f"known: {sorted(impls)}")
+    return impls[bundle.name](bundle.config)

@@ -24,13 +24,15 @@ class TransformerConfig:
     head_dim: Optional[int] = None      # None → hidden_size // num_heads
     intermediate_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 4096
-    activation: str = "swiglu"          # "swiglu" | "gelu"
+    activation: str = "swiglu"          # "swiglu" | "gelu" | "relu"
     norm: str = "rmsnorm"               # "rmsnorm" | "layernorm"
     position: str = "rope"              # "rope" | "learned"
+    position_offset: int = 0            # learned-position index offset (OPT: 2)
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     use_bias: bool = False
+    qkv_bias: bool = False              # bias on q/k/v only (Qwen2)
     causal: bool = True
     # MoE (Mixtral-style; 0 experts → dense)
     num_experts: int = 0

@@ -89,11 +89,14 @@ def init_attention(rng, cfg: TransformerConfig):
         "wv": ("embed", "kv_heads", "head_dim"),
         "wo": ("heads", "head_dim", "embed"),
     }
-    if cfg.use_bias:
+    if cfg.use_bias or cfg.qkv_bias:
         params.update(bq=_zeros((h, d), cfg.p_dtype), bk=_zeros((kvh, d), cfg.p_dtype),
-                      bv=_zeros((kvh, d), cfg.p_dtype), bo=_zeros((e,), cfg.p_dtype))
+                      bv=_zeros((kvh, d), cfg.p_dtype))
         axes.update(bq=("heads", "head_dim"), bk=("kv_heads", "head_dim"),
-                    bv=("kv_heads", "head_dim"), bo=("embed",))
+                    bv=("kv_heads", "head_dim"))
+    if cfg.use_bias:
+        params.update(bo=_zeros((e,), cfg.p_dtype))
+        axes.update(bo=("embed",))
     return params, axes
 
 
@@ -108,7 +111,7 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
     q = jnp.einsum("bse,ehd->bshd", x, params["wq"].astype(dt))
     k = jnp.einsum("bse,ehd->bshd", x, params["wk"].astype(dt))
     v = jnp.einsum("bse,ehd->bshd", x, params["wv"].astype(dt))
-    if cfg.use_bias:
+    if cfg.use_bias or cfg.qkv_bias:
         q = q + params["bq"].astype(dt)
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
@@ -181,7 +184,8 @@ def apply_mlp(params, x, cfg: TransformerConfig):
         h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
         if cfg.use_bias:
             h = h + params["bi"].astype(dt)
-        h = jax.nn.gelu(h, approximate=True)
+        h = jax.nn.relu(h) if cfg.activation == "relu" \
+            else jax.nn.gelu(h, approximate=True)
     y = jnp.einsum("bsf,fe->bse", h, params["wo"].astype(dt))
     if cfg.use_bias:
         y = y + params["bo"].astype(dt)

@@ -153,7 +153,7 @@ class CausalLM:
         if cfg.position == "learned":
             if positions is None:
                 positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
-            h = h + embed_params["pos"].astype(dt)[positions]
+            h = h + embed_params["pos"].astype(dt)[positions + cfg.position_offset]
         return h
 
     def head_loss(self, head_params, h, labels, loss_mask=None):
@@ -251,7 +251,7 @@ class CausalLM:
         positions = cache_len[:, None] + jnp.arange(s)[None, :]
         h = params["embed"]["tok"].astype(dt)[input_ids]
         if cfg.position == "learned":
-            h = h + params["embed"]["pos"].astype(dt)[positions]
+            h = h + params["embed"]["pos"].astype(dt)[positions + cfg.position_offset]
 
         def body(h, layer_in):
             lp, ck, cv = layer_in

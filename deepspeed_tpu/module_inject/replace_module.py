@@ -32,114 +32,9 @@ def _np(t):
 
 
 def hf_config_to_native(hf_cfg) -> TransformerConfig:
-    """Map an HF PretrainedConfig to TransformerConfig."""
-    arch = (getattr(hf_cfg, "architectures", None) or [type(hf_cfg).__name__])[0].lower()
-    get = lambda *names, default=None: next(
-        (getattr(hf_cfg, n) for n in names if getattr(hf_cfg, n, None) is not None), default)
-
-    if "gpt2" in arch:
-        return TransformerConfig(
-            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.n_embd,
-            num_layers=hf_cfg.n_layer, num_heads=hf_cfg.n_head,
-            intermediate_size=4 * hf_cfg.n_embd, max_seq_len=hf_cfg.n_positions,
-            activation="gelu", norm="layernorm", position="learned",
-            tie_embeddings=True, use_bias=True, norm_eps=hf_cfg.layer_norm_epsilon)
-    # llama-family default (llama/mistral/mixtral/qwen2)
-    num_experts = get("num_local_experts", "num_experts", default=0) or 0
-    return TransformerConfig(
-        vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
-        num_layers=get("num_hidden_layers", "n_layer"),
-        num_heads=get("num_attention_heads", "n_head"),
-        num_kv_heads=get("num_key_value_heads"),
-        intermediate_size=get("intermediate_size"),
-        max_seq_len=get("max_position_embeddings", default=4096),
-        rope_theta=float(get("rope_theta", default=10000.0)),
-        norm_eps=float(get("rms_norm_eps", "layer_norm_epsilon", default=1e-5)),
-        tie_embeddings=bool(get("tie_word_embeddings", default=False)),
-        num_experts=int(num_experts),
-        num_experts_per_tok=int(get("num_experts_per_tok", default=2) or 2))
-
-
-def _llama_like_params(sd: Dict[str, Any], cfg: TransformerConfig, prefix="model."):
-    e, h, kvh, d = cfg.hidden_size, cfg.num_heads, cfg.kv_heads, cfg.dims_per_head
-    L = cfg.num_layers
-
-    def w(name):
-        return _np(sd[name])
-
-    layers = {"attn": {"wq": [], "wk": [], "wv": [], "wo": []},
-              "norm1": {"scale": []}, "norm2": {"scale": []}}
-    if cfg.is_moe:
-        layers["mlp"] = {"router": [], "wi_gate": [], "wi_up": [], "wo": []}
-    else:
-        layers["mlp"] = {"wi_gate": [], "wi_up": [], "wo": []}
-
-    for i in range(L):
-        p = f"{prefix}layers.{i}."
-        layers["attn"]["wq"].append(w(p + "self_attn.q_proj.weight").T.reshape(e, h, d))
-        layers["attn"]["wk"].append(w(p + "self_attn.k_proj.weight").T.reshape(e, kvh, d))
-        layers["attn"]["wv"].append(w(p + "self_attn.v_proj.weight").T.reshape(e, kvh, d))
-        layers["attn"]["wo"].append(w(p + "self_attn.o_proj.weight").T.reshape(h, d, e))
-        layers["norm1"]["scale"].append(w(p + "input_layernorm.weight"))
-        layers["norm2"]["scale"].append(w(p + "post_attention_layernorm.weight"))
-        if cfg.is_moe:
-            x = cfg.num_experts
-            layers["mlp"]["router"].append(w(p + "block_sparse_moe.gate.weight").T)
-            layers["mlp"]["wi_gate"].append(np.stack(
-                [w(p + f"block_sparse_moe.experts.{n}.w1.weight").T for n in range(x)]))
-            layers["mlp"]["wi_up"].append(np.stack(
-                [w(p + f"block_sparse_moe.experts.{n}.w3.weight").T for n in range(x)]))
-            layers["mlp"]["wo"].append(np.stack(
-                [w(p + f"block_sparse_moe.experts.{n}.w2.weight").T for n in range(x)]))
-        else:
-            layers["mlp"]["wi_gate"].append(w(p + "mlp.gate_proj.weight").T)
-            layers["mlp"]["wi_up"].append(w(p + "mlp.up_proj.weight").T)
-            layers["mlp"]["wo"].append(w(p + "mlp.down_proj.weight").T)
-
-    stacked = {k: {kk: np.stack(vv) for kk, vv in sub.items()} for k, sub in layers.items()}
-    emb = {"tok": w(prefix + "embed_tokens.weight")}
-    if not cfg.tie_embeddings:
-        emb["lm_head"] = w("lm_head.weight").T
-    return {"embed": emb, "layers": stacked,
-            "final_norm": {"scale": w(prefix + "norm.weight")}}
-
-
-def _gpt2_params(sd: Dict[str, Any], cfg: TransformerConfig):
-    e, h, d = cfg.hidden_size, cfg.num_heads, cfg.dims_per_head
-
-    def w(name):
-        return _np(sd[name])
-
-    layers = {"attn": {"wq": [], "wk": [], "wv": [], "wo": [],
-                       "bq": [], "bk": [], "bv": [], "bo": []},
-              "mlp": {"wi": [], "wo": [], "bi": [], "bo": []},
-              "norm1": {"scale": [], "bias": []}, "norm2": {"scale": [], "bias": []}}
-    for i in range(cfg.num_layers):
-        p = f"h.{i}." if f"h.{i}.ln_1.weight" in sd else f"transformer.h.{i}."
-        ca = w(p + "attn.c_attn.weight")          # (E, 3E) Conv1D layout
-        cb = w(p + "attn.c_attn.bias")            # (3E,)
-        layers["attn"]["wq"].append(ca[:, :e].reshape(e, h, d))
-        layers["attn"]["wk"].append(ca[:, e:2 * e].reshape(e, h, d))
-        layers["attn"]["wv"].append(ca[:, 2 * e:].reshape(e, h, d))
-        layers["attn"]["bq"].append(cb[:e].reshape(h, d))
-        layers["attn"]["bk"].append(cb[e:2 * e].reshape(h, d))
-        layers["attn"]["bv"].append(cb[2 * e:].reshape(h, d))
-        layers["attn"]["wo"].append(w(p + "attn.c_proj.weight").reshape(h, d, e))
-        layers["attn"]["bo"].append(w(p + "attn.c_proj.bias"))
-        layers["mlp"]["wi"].append(w(p + "mlp.c_fc.weight"))
-        layers["mlp"]["bi"].append(w(p + "mlp.c_fc.bias"))
-        layers["mlp"]["wo"].append(w(p + "mlp.c_proj.weight"))
-        layers["mlp"]["bo"].append(w(p + "mlp.c_proj.bias"))
-        layers["norm1"]["scale"].append(w(p + "ln_1.weight"))
-        layers["norm1"]["bias"].append(w(p + "ln_1.bias"))
-        layers["norm2"]["scale"].append(w(p + "ln_2.weight"))
-        layers["norm2"]["bias"].append(w(p + "ln_2.bias"))
-
-    pre = "" if "wte.weight" in sd else "transformer."
-    stacked = {k: {kk: np.stack(vv) for kk, vv in sub.items()} for k, sub in layers.items()}
-    return {"embed": {"tok": w(pre + "wte.weight"), "pos": w(pre + "wpe.weight")},
-            "layers": stacked,
-            "final_norm": {"scale": w(pre + "ln_f.weight"), "bias": w(pre + "ln_f.bias")}}
+    """Map an HF PretrainedConfig to TransformerConfig (container-resolved)."""
+    from ..inference.v2.model_implementations import resolve_container
+    return resolve_container(hf_cfg).config(hf_cfg)
 
 
 def replace_transformer_layer(orig_layer_impl=None, model=None, checkpoint_dict=None,
@@ -150,25 +45,20 @@ def replace_transformer_layer(orig_layer_impl=None, model=None, checkpoint_dict=
 
 
 def hf_to_native(hf_model) -> Tuple[CausalLM, Dict]:
-    """Convert an HF transformers model instance → (CausalLM, param pytree)."""
-    hf_cfg = hf_model.config
-    cfg = hf_config_to_native(hf_cfg)
-    sd = dict(hf_model.state_dict())
-    arch = (getattr(hf_cfg, "architectures", None) or [type(hf_model).__name__])[0].lower()
-    if "gpt2" in arch:
-        params = _gpt2_params(sd, cfg)
-    elif any(a in arch for a in ("llama", "mistral", "mixtral", "qwen")):
-        prefix = "model." if any(k.startswith("model.") for k in sd) else ""
-        params = _llama_like_params(sd, cfg, prefix=prefix)
-    else:
-        raise NotImplementedError(
-            f"No injection policy for architecture {arch!r} "
-            f"(reference parity list: containers/*.py); supported: gpt2, llama, "
-            f"mistral, mixtral, qwen2")
+    """Convert an HF transformers model instance → (CausalLM, param pytree).
+
+    Delegates to the v2 model-implementation containers
+    (``inference/v2/model_implementations/archs.py``) — the declarative
+    per-arch weight mappings (llama/mistral/mixtral/qwen2/qwen2-moe/phi3/
+    opt/gpt2) are the single source of truth for checkpoint injection.
+    """
+    from ..inference.v2.model_implementations import build_native
+    model, params = build_native(hf_model)
     params = {k: _tree_to_jnp(v) for k, v in params.items()}
     n = sum(x.size for x in _leaves(params))
-    logger.info(f"Injected {arch}: {n / 1e6:.1f}M params → native CausalLM")
-    return CausalLM(cfg), params
+    logger.info(f"Injected {type(hf_model).__name__}: {n / 1e6:.1f}M params "
+                f"→ native CausalLM")
+    return model, params
 
 
 def _tree_to_jnp(tree):

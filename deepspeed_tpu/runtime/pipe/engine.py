@@ -344,7 +344,7 @@ def build_pipeline_loss(model, num_stages: int):
         flat_ids = ids.reshape(m * mb, s)
         h = params["embed"]["tok"].astype(dt)[flat_ids]
         if cfg.position == "learned":
-            pos = jnp.broadcast_to(jnp.arange(s), (m * mb, s))
+            pos = jnp.broadcast_to(jnp.arange(s) + cfg.position_offset, (m * mb, s))
             h = h + params["embed"]["pos"].astype(dt)[pos]
         h = h.reshape(m, mb, s, cfg.hidden_size)
 
