@@ -22,13 +22,16 @@ DEFAULT_MICRO_BATCHES = (1, 2, 4, 8, 16, 32)
 class Autotuner:
     def __init__(self, model, base_config: Dict[str, Any], seq_len: int = 512,
                  micro_batch_candidates=DEFAULT_MICRO_BATCHES,
-                 zero_stage_candidates=(0, 1, 2, 3), steps_per_trial: int = 3):
+                 zero_stage_candidates=(0, 1, 2, 3), steps_per_trial: int = 3,
+                 strategy: str = "heuristic", max_trials: Optional[int] = None):
         self.model = model
         self.base_config = dict(base_config)
         self.seq_len = seq_len
         self.mb_candidates = list(micro_batch_candidates)
         self.stage_candidates = list(zero_stage_candidates)
         self.steps_per_trial = steps_per_trial
+        self.strategy = strategy          # "heuristic" | tuner.TUNERS names
+        self.max_trials = max_trials
         self.results: List[Dict[str, Any]] = []
 
     def model_info(self) -> Dict[str, Any]:
@@ -77,9 +80,16 @@ class Autotuner:
             return None
 
     def tune(self, fast: bool = True) -> Dict[str, Any]:
-        """Run the search; returns the best config patch (reference tune:404)."""
+        """Run the search; returns the best config patch (reference tune:404).
+
+        ``strategy="heuristic"`` keeps the monotone micro-batch climb with
+        early stops; "gridsearch"/"random"/"model_based" route trial order
+        through ``autotuning/tuner.py`` (reference tuner strategies), with
+        ``max_trials`` as the experiment budget."""
         info = self.model_info()
         logger.info(f"autotuning: model={info['num_params'] / 1e6:.1f}M params")
+        if self.strategy != "heuristic":
+            return self._tune_with_strategy()
         stages = [self.stage_candidates[0]] if fast and len(self.stage_candidates) > 1 \
             else self.stage_candidates
         best = None
@@ -103,5 +113,30 @@ class Autotuner:
         return {
             "train_micro_batch_size_per_gpu": best["micro_batch"],
             "zero_optimization": {"stage": best["zero_stage"]},
+            "autotuning_results": self.results,
+        }
+
+    def _tune_with_strategy(self) -> Dict[str, Any]:
+        from .tuner import build_tuner
+        experiments = [{"zero_stage": s, "micro_batch": mb}
+                       for s in self.stage_candidates for mb in self.mb_candidates]
+        tuner = build_tuner(self.strategy, experiments)
+        budget = self.max_trials or len(experiments)
+        for _ in range(budget):
+            if not tuner.has_next():
+                break
+            exp = tuner.next_trial()
+            tput = self._trial(exp["zero_stage"], exp["micro_batch"])
+            tuner.update(exp, tput)
+            self.results.append({**exp, "tokens_per_sec": tput})
+        top = tuner.best()
+        if top is None:
+            raise RuntimeError("autotuning: no trial succeeded")
+        best_exp, best_tput = top
+        logger.info(f"autotuning[{self.strategy}] best: {best_exp} "
+                    f"({best_tput:,.0f} tok/s)")
+        return {
+            "train_micro_batch_size_per_gpu": best_exp["micro_batch"],
+            "zero_optimization": {"stage": best_exp["zero_stage"]},
             "autotuning_results": self.results,
         }

@@ -1,0 +1,134 @@
+"""Autotuning search strategies.
+
+Analog of ``deepspeed/autotuning/tuner/`` (GridSearchTuner, RandomTuner,
+ModelBasedTuner over experiment lists): a tuner proposes which experiment
+(config candidate) to run next and records measured metrics; the Autotuner
+drives trials through it. The model-based strategy fits a saturating
+throughput curve t(mb) = mb / (a + b*mb) per discrete setting group and
+explores the candidate with the highest predicted metric — the same
+explore/exploit shape as the reference's cost-model tuner without the
+XGBoost dependency.
+"""
+
+import random
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+Experiment = Dict[str, Any]
+
+
+class BaseTuner:
+    """Propose-next / record-result protocol."""
+
+    def __init__(self, experiments: Sequence[Experiment], seed: int = 0):
+        self.experiments = list(experiments)
+        self.results: List[Tuple[Experiment, Optional[float]]] = []
+        self._tried = set()
+        self._rng = random.Random(seed)
+
+    def _key(self, exp: Experiment):
+        return tuple(sorted(exp.items()))
+
+    def has_next(self) -> bool:
+        return len(self._tried) < len(self.experiments)
+
+    def next_trial(self) -> Experiment:
+        raise NotImplementedError
+
+    def update(self, exp: Experiment, metric: Optional[float]):
+        self._tried.add(self._key(exp))
+        self.results.append((exp, metric))
+
+    def best(self) -> Optional[Tuple[Experiment, float]]:
+        done = [(e, m) for e, m in self.results if m is not None]
+        return max(done, key=lambda em: em[1]) if done else None
+
+
+class GridSearchTuner(BaseTuner):
+    """Exhaustive, in declaration order (reference GridSearchTuner)."""
+
+    def next_trial(self) -> Experiment:
+        for e in self.experiments:
+            if self._key(e) not in self._tried:
+                return e
+        raise StopIteration
+
+
+class RandomTuner(BaseTuner):
+    """Uniform random without replacement (reference RandomTuner)."""
+
+    def next_trial(self) -> Experiment:
+        remaining = [e for e in self.experiments if self._key(e) not in self._tried]
+        if not remaining:
+            raise StopIteration
+        return self._rng.choice(remaining)
+
+
+class ModelBasedTuner(BaseTuner):
+    """Cost-model guided (reference ModelBasedTuner).
+
+    Groups experiments by their non-numeric settings (e.g. zero stage);
+    within a group, fits t(mb) = mb / (a + b*mb) to the measured points
+    (linear least squares on mb/t = a + b*mb) and predicts the metric for
+    untried micro-batches. Proposes the untried experiment with the highest
+    predicted metric; unseen groups get one exploratory probe first.
+    """
+
+    def __init__(self, experiments, numeric_key: str = "micro_batch", seed: int = 0):
+        super().__init__(experiments, seed)
+        self.numeric_key = numeric_key
+
+    def _group(self, exp: Experiment):
+        return tuple(sorted((k, v) for k, v in exp.items() if k != self.numeric_key))
+
+    def _fit(self, pts: List[Tuple[float, float]]):
+        # least squares for mb/t = a + b*mb
+        if len(pts) == 1:
+            mb, t = pts[0]
+            return mb / t, 0.0
+        xs = [mb for mb, _ in pts]
+        ys = [mb / t for mb, t in pts]
+        n = len(xs)
+        sx, sy = sum(xs), sum(ys)
+        sxx = sum(x * x for x in xs)
+        sxy = sum(x * y for x, y in zip(xs, ys))
+        denom = n * sxx - sx * sx
+        if abs(denom) < 1e-12:
+            return sy / n, 0.0
+        b = (n * sxy - sx * sy) / denom
+        a = (sy - b * sx) / n
+        return a, b
+
+    def _predict(self, exp: Experiment) -> Optional[float]:
+        pts = [(e[self.numeric_key], m) for e, m in self.results
+               if m is not None and self._group(e) == self._group(exp)]
+        if not pts:
+            return None
+        a, b = self._fit(pts)
+        mb = exp[self.numeric_key]
+        denom = a + b * mb
+        if denom <= 0:
+            return 0.0
+        return mb / denom
+
+    def next_trial(self) -> Experiment:
+        remaining = [e for e in self.experiments if self._key(e) not in self._tried]
+        if not remaining:
+            raise StopIteration
+        # one exploratory probe (smallest numeric) for any unseen group
+        for e in sorted(remaining, key=lambda x: x[self.numeric_key]):
+            if self._predict(e) is None:
+                return e
+        return max(remaining, key=lambda e: self._predict(e))
+
+
+TUNERS: Dict[str, type] = {
+    "gridsearch": GridSearchTuner,
+    "random": RandomTuner,
+    "model_based": ModelBasedTuner,
+}
+
+
+def build_tuner(name: str, experiments, **kw) -> BaseTuner:
+    if name not in TUNERS:
+        raise ValueError(f"unknown tuner strategy {name!r}; known: {sorted(TUNERS)}")
+    return TUNERS[name](experiments, **kw)

@@ -296,3 +296,65 @@ def test_engine_emits_monitor_events(tmp_path):
     joined = " ".join(str(f) for f in files)
     for key in ("loss", "lr", "loss_scale"):
         assert any(key in str(f) for f in files), (key, files)
+
+
+# ---- autotuner strategies ----
+
+def test_tuner_strategies():
+    """Grid covers everything in order; random covers everything; model-based
+    fits the saturating throughput curve and converges on the best candidate
+    without exhausting the grid (reference autotuning/tuner/)."""
+    from deepspeed_tpu.autotuning.tuner import (GridSearchTuner, ModelBasedTuner,
+                                                RandomTuner, build_tuner)
+    exps = [{"zero_stage": s, "micro_batch": mb}
+            for s in (0, 1) for mb in (1, 2, 4, 8)]
+
+    def true_tput(e):       # saturating in mb, stage 1 slightly slower
+        base = e["micro_batch"] / (0.5 + 0.05 * e["micro_batch"])
+        return base * (0.9 if e["zero_stage"] == 1 else 1.0)
+
+    g = GridSearchTuner(exps)
+    order = []
+    while g.has_next():
+        e = g.next_trial()
+        order.append(e)
+        g.update(e, true_tput(e))
+    assert order == exps
+    assert g.best()[0] == {"zero_stage": 0, "micro_batch": 8}
+
+    r = RandomTuner(exps, seed=3)
+    while r.has_next():
+        e = r.next_trial()
+        r.update(e, true_tput(e))
+    assert r.best()[0] == {"zero_stage": 0, "micro_batch": 8}
+
+    m = ModelBasedTuner(exps)
+    for _ in range(6):      # under-budget: 6 of 8 trials
+        e = m.next_trial()
+        m.update(e, true_tput(e))
+    assert m.best()[0]["micro_batch"] == 8   # model extrapolates to the top
+
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        build_tuner("nope", exps)
+
+
+def test_autotuner_strategy_integration(monkeypatch):
+    """Autotuner routes trials through the selected strategy."""
+    from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+    class FakeModel:
+        class cfg:
+            vocab_size = 16
+        def param_count(self):
+            return 1000
+
+    at = Autotuner(FakeModel(), {}, micro_batch_candidates=(1, 2, 4),
+                   zero_stage_candidates=(0, 1), strategy="model_based",
+                   max_trials=4)
+    monkeypatch.setattr(at, "_trial",
+                        lambda s, mb: mb / (0.5 + 0.1 * mb) * (0.8 if s else 1.0))
+    patch = at.tune()
+    assert patch["train_micro_batch_size_per_gpu"] == 4
+    assert patch["zero_optimization"]["stage"] == 0
+    assert len(at.results) <= 4
