@@ -227,29 +227,147 @@ def log_summary(show_straggler=False):
 
 # ---- eager collectives (host-level / benchmarking) ----
 
+class CommHandle:
+    """Async work handle (reference async_op=True contract). XLA dispatch is
+    already asynchronous, so the collective is in flight the moment the
+    handle exists; ``wait()`` blocks until the result is materialized and
+    returns it. Coalesced placeholders resolve on manager exit."""
+
+    def __init__(self, result=None):
+        self._result = result
+
+    def _set(self, result):
+        self._result = result
+
+    def wait(self):
+        import jax
+        if self._result is None:
+            raise RuntimeError("handle not resolved — still inside an open "
+                               "coalescing_manager block?")
+        jax.block_until_ready(self._result)
+        return self._result
+
+    def is_completed(self):
+        if self._result is None:
+            return False
+        try:
+            return self._result.is_ready()
+        except AttributeError:
+            return True
+
+    @property
+    def result(self):
+        return self.wait()
+
+
+class _Coalescer:
+    """Batches collectives issued inside ``coalescing_manager`` into one
+    flat call per (kind, op) — the reference TorchBackend coalescing
+    manager (``comm/torch.py:41``) / ZeRO's allgather bucket analog."""
+
+    def __init__(self, group):
+        self.group = group
+        self.pending = []   # (kind, op, tensor, handle)
+
+    def add(self, kind, op, tensor):
+        h = CommHandle()
+        self.pending.append((kind, op, tensor, h))
+        return h
+
+    def flush(self):
+        import jax.numpy as jnp
+        from collections import defaultdict
+        groups_ = defaultdict(list)
+        for kind, op, tensor, h in self.pending:
+            groups_[(kind, op, tensor.dtype)].append((tensor, h))
+        for (kind, op, _dtype), items in groups_.items():
+            tensors = [t.reshape(-1) for t, _ in items]
+            sizes = [t.size for t in tensors]
+            flat = jnp.concatenate(tensors)
+            if kind == "all_reduce":
+                out = _ensure_backend().all_reduce(flat, op=op, group=self.group)
+                outs = jnp.split(out, list(_np_cumsum(sizes)[:-1]))
+                for (t, h), o in zip(items, outs):
+                    h._set(o.reshape(t.shape))
+            elif kind == "all_gather":
+                out = _ensure_backend().all_gather_into_tensor(flat, group=self.group)
+                n = out.shape[0] // flat.shape[0]
+                per_rank = out.reshape(n, flat.shape[0])
+                offs = _np_cumsum(sizes)
+                start = 0
+                for (t, h), end in zip(items, offs):
+                    h._set(per_rank[:, start:end].reshape((n * t.size,)))
+                    start = end
+            else:
+                raise NotImplementedError(kind)
+        self.pending.clear()
+
+
+def _np_cumsum(sizes):
+    import numpy as _np
+    return _np.cumsum(sizes)
+
+
+_ACTIVE_COALESCER = None
+
+
+def coalescing_manager(group=None, async_op=True):
+    """Context manager: collectives issued inside are batched into one flat
+    exchange per (kind, op) on exit; each call returns a ``CommHandle`` that
+    resolves after the flush (reference ``comm/torch.py:41``)."""
+    import contextlib
+
+    @contextlib.contextmanager
+    def cm():
+        global _ACTIVE_COALESCER
+        prev = _ACTIVE_COALESCER
+        _ACTIVE_COALESCER = _Coalescer(group)
+        try:
+            yield _ACTIVE_COALESCER
+            _ACTIVE_COALESCER.flush()
+        finally:
+            _ACTIVE_COALESCER = prev
+
+    return cm()
+
+
+def _maybe_handle(result, async_op):
+    return CommHandle(result) if async_op else result
+
+
 @timed_op
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, async_op=False):
-    return _ensure_backend().all_reduce(tensor, op=op, group=group)
+    if _ACTIVE_COALESCER is not None:
+        return _ACTIVE_COALESCER.add("all_reduce", op, tensor)
+    return _maybe_handle(_ensure_backend().all_reduce(tensor, op=op, group=group),
+                         async_op)
 
 
 @timed_op
 def all_gather_into_tensor(tensor, group=None, async_op=False):
-    return _ensure_backend().all_gather_into_tensor(tensor, group=group)
+    if _ACTIVE_COALESCER is not None:
+        return _ACTIVE_COALESCER.add("all_gather", None, tensor)
+    return _maybe_handle(
+        _ensure_backend().all_gather_into_tensor(tensor, group=group), async_op)
 
 
 @timed_op
 def reduce_scatter_tensor(tensor, op=ReduceOp.SUM, group=None, async_op=False):
-    return _ensure_backend().reduce_scatter_tensor(tensor, op=op, group=group)
+    return _maybe_handle(
+        _ensure_backend().reduce_scatter_tensor(tensor, op=op, group=group),
+        async_op)
 
 
 @timed_op
 def all_to_all_single(tensor, scatter_dim=0, gather_dim=0, group=None, async_op=False):
-    return _ensure_backend().all_to_all_single(tensor, scatter_dim=scatter_dim, gather_dim=gather_dim, group=group)
+    return _maybe_handle(_ensure_backend().all_to_all_single(
+        tensor, scatter_dim=scatter_dim, gather_dim=gather_dim, group=group), async_op)
 
 
 @timed_op
 def broadcast(tensor, src=0, group=None, async_op=False):
-    return _ensure_backend().broadcast(tensor, src=src, group=group)
+    return _maybe_handle(_ensure_backend().broadcast(tensor, src=src, group=group),
+                         async_op)
 
 
 def barrier(group=None):

@@ -121,3 +121,40 @@ def test_topology_ranks():
     assert topo.get_dim("pipe") == 2
     lists = topo.get_axis_comm_lists("pipe")
     assert len(lists) == 4 and all(len(l) == 2 for l in lists)
+
+
+def test_async_op_handles(mesh_8dp):
+    """async_op=True returns a work handle whose wait() yields the result
+    (reference handle contract; dispatch is already async under XLA)."""
+    import deepspeed_tpu.comm as dist
+    x = jnp.ones((64,))
+    h = dist.all_reduce(x, async_op=True)
+    assert hasattr(h, "wait")
+    out = h.wait()
+    np.testing.assert_allclose(np.asarray(out), 8.0)
+    assert h.is_completed()
+
+
+def test_coalescing_manager(mesh_8dp):
+    """Collectives inside coalescing_manager batch into ONE backend call per
+    kind and resolve through their handles (reference comm/torch.py:41)."""
+    import deepspeed_tpu.comm as dist
+    from deepspeed_tpu.comm import comm as comm_mod
+    backend = comm_mod._ensure_backend()
+    calls = {"n": 0}
+    orig = backend.all_reduce
+
+    def counting(tensor, **kw):
+        calls["n"] += 1
+        return orig(tensor, **kw)
+
+    backend.all_reduce = counting
+    try:
+        xs = [jnp.full((n,), float(i + 1)) for i, n in enumerate((8, 16, 32))]
+        with dist.coalescing_manager() as cm:
+            handles = [dist.all_reduce(x) for x in xs]
+        assert calls["n"] == 1          # one flat exchange
+        for i, h in enumerate(handles):
+            np.testing.assert_allclose(np.asarray(h.wait()), 8.0 * (i + 1))
+    finally:
+        backend.all_reduce = orig
