@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""DeepNVMe I/O benchmark sweep.
+
+Analog of the reference's ``csrc/aio/py_test/aio_bench_perf_sweep.py``
+(BASELINE row: 10 GB/s reads / 5 GB/s writes on 4xNVMe RAID-0): sweeps
+(queue depth, block size, O_DIRECT) over the native async I/O engine
+(``ops/csrc/aio/deepspeed_aio.cpp``) and prints one JSON line with the best
+read/write bandwidth. Point --dir at the NVMe mount to benchmark.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bench_one(path, size_bytes, queue_depth, block_size, direct, iters=3):
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    h = AsyncIOHandle(queue_depth=queue_depth, block_size=block_size,
+                      use_direct=direct)
+    buf = np.random.default_rng(0).integers(0, 255, size_bytes, np.uint8)
+    # write bandwidth
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        h.async_pwrite(buf, path)
+        errs = h.wait()
+        assert not errs, f"aio write errors: {errs}"
+        os.sync() if direct else None
+    w_bw = size_bytes * iters / (time.perf_counter() - t0) / 1e9
+    # read bandwidth (drop page cache effect is limited without root; O_DIRECT
+    # bypasses it)
+    out = np.empty_like(buf)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        h.async_pread(out, path)
+        errs = h.wait()
+        assert not errs, f"aio read errors: {errs}"
+    r_bw = size_bytes * iters / (time.perf_counter() - t0) / 1e9
+    return r_bw, w_bw
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dir", default=None, help="target dir (NVMe mount)")
+    ap.add_argument("--size-mb", type=int, default=256)
+    args = ap.parse_args()
+    d = args.dir or tempfile.mkdtemp()
+    path = os.path.join(d, "aio_bench.bin")
+    size = args.size_mb << 20
+
+    best = {"read_gbps": 0.0, "write_gbps": 0.0}
+    results = []
+    for qd in (4, 8, 16):
+        for bs_mb in (1, 8):
+            for direct in (False, True):
+                try:
+                    r, w = bench_one(path, size, qd, bs_mb << 20, direct)
+                except Exception as e:
+                    results.append({"qd": qd, "bs_mb": bs_mb, "direct": direct,
+                                    "error": str(e)[:80]})
+                    continue
+                results.append({"qd": qd, "bs_mb": bs_mb, "direct": direct,
+                                "read_gbps": round(r, 2), "write_gbps": round(w, 2)})
+                if r > best["read_gbps"]:
+                    best.update(read_gbps=round(r, 2), read_cfg=(qd, bs_mb, direct))
+                if w > best["write_gbps"]:
+                    best.update(write_gbps=round(w, 2), write_cfg=(qd, bs_mb, direct))
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+    print(json.dumps({"metric": "aio_bandwidth", "unit": "GB/s",
+                      "best": best, "sweep": results}))
+
+
+if __name__ == "__main__":
+    main()

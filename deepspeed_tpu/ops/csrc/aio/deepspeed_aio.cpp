@@ -80,14 +80,22 @@ struct AioHandle {
 
     int do_io(const Request& req) {
         int flags = req.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+        bool direct = false;
 #ifdef O_DIRECT
-        if (use_direct) flags |= O_DIRECT;
+        if (use_direct) { flags |= O_DIRECT; direct = true; }
 #endif
         int fd = ::open(req.path.c_str(), flags, 0644);
-        if (fd < 0 && use_direct) {  // filesystem may not support O_DIRECT
+        if (fd < 0 && direct) {  // filesystem may not support O_DIRECT
             fd = ::open(req.path.c_str(), req.write ? (O_WRONLY | O_CREAT) : O_RDONLY, 0644);
+            direct = false;
         }
         if (fd < 0) return -1;
+        int rc = direct ? do_io_direct(fd, req) : do_io_buffered(fd, req);
+        ::close(fd);
+        return rc;
+    }
+
+    int do_io_buffered(int fd, const Request& req) {
         int64_t remaining = req.nbytes;
         char* p = static_cast<char*>(req.buf);
         int64_t off = req.offset;
@@ -95,17 +103,55 @@ struct AioHandle {
         while (remaining > 0) {
             int64_t n = remaining < block_size ? remaining : block_size;
             ssize_t r = req.write ? ::pwrite(fd, p, n, off) : ::pread(fd, p, n, off);
-            if (r < 0) {
-                ::close(fd);
-                return -1;
-            }
+            if (r < 0) return -1;
             if (r == 0) break;  // EOF on read
             p += r;
             off += r;
             remaining -= r;
         }
-        ::close(fd);
         return remaining == 0 ? 0 : (req.write ? -1 : 0);
+    }
+
+    // O_DIRECT path: user buffers are arbitrary numpy memory, so stage
+    // through a page-aligned bounce buffer (the pinned-buffer-manager role
+    // of the reference's deepspeed_pin_tensor.cpp). Offsets are assumed
+    // block-aligned (the swapper writes whole tensors at offset 0); a
+    // ragged tail is completed with an aligned full-sector transfer for
+    // writes (file extended, then truncated back) and a short read retry
+    // without O_DIRECT for reads.
+    int do_io_direct(int fd, const Request& req) {
+        constexpr int64_t kAlign = 4096;
+        if (req.offset % kAlign != 0) return do_io_buffered(fd, req);
+        void* bounce = nullptr;
+        int64_t buf_len = block_size < kAlign ? kAlign : block_size;
+        if (posix_memalign(&bounce, kAlign, buf_len) != 0) return -1;
+        char* user = static_cast<char*>(req.buf);
+        int64_t off = req.offset;
+        int64_t remaining = req.nbytes;
+        int rc = 0;
+        while (remaining > 0 && rc == 0) {
+            int64_t n = remaining < buf_len ? remaining : buf_len;
+            int64_t n_aligned = (n + kAlign - 1) / kAlign * kAlign;
+            if (req.write) {
+                memcpy(bounce, user, n);
+                if (n_aligned > n) memset(static_cast<char*>(bounce) + n, 0, n_aligned - n);
+                ssize_t r = ::pwrite(fd, bounce, n_aligned, off);
+                if (r != n_aligned) { rc = -1; break; }
+            } else {
+                ssize_t r = ::pread(fd, bounce, n_aligned, off);
+                if (r < n) { rc = -1; break; }  // short read of live range
+                memcpy(user, bounce, n);
+            }
+            user += n;
+            off += n;
+            remaining -= n;
+        }
+        free(bounce);
+        if (rc == 0 && req.write && (req.nbytes % kAlign) != 0) {
+            // trim the zero padding the last aligned sector appended
+            if (::ftruncate(fd, req.offset + req.nbytes) != 0) rc = -1;
+        }
+        return rc;
     }
 
     int64_t submit(bool write, const char* path, void* buf, int64_t nbytes, int64_t offset) {
