@@ -257,6 +257,83 @@ class GPT2Container(LayerContainer):
             norm_eps=hf_cfg.layer_norm_epsilon)
 
 
+def _t_neox_qkv(idx):
+    """NeoX fused query_key_value is HEAD-interleaved: (heads*3*d, e)."""
+
+    def t(w, cfg):
+        h, d, e = cfg.num_heads, cfg.dims_per_head, cfg.hidden_size
+        part = w.reshape(h, 3, d, e)[:, idx]       # (heads, d, e)
+        return part.transpose(2, 0, 1)             # (e, heads, d)
+
+    return t
+
+
+def _t_neox_qkv_bias(idx):
+    def t(b, cfg):
+        h, d = cfg.num_heads, cfg.dims_per_head
+        return b.reshape(h, 3, d)[:, idx]
+
+    return t
+
+
+def _t_neox_o(w, cfg):
+    return w.T.reshape(cfg.num_heads, cfg.dims_per_head, cfg.hidden_size)
+
+
+class GPTNeoXContainer(LayerContainer):
+    """GPT-NeoX / Pythia: head-interleaved fused QKV, partial rotary
+    (``rotary_pct``), parallel attention+MLP residual, exact-erf gelu."""
+
+    layer_mapping = {
+        "attn.wq": Param("gpt_neox.layers.{l}.attention.query_key_value.weight",
+                         _t_neox_qkv(0)),
+        "attn.wk": Param("gpt_neox.layers.{l}.attention.query_key_value.weight",
+                         _t_neox_qkv(1)),
+        "attn.wv": Param("gpt_neox.layers.{l}.attention.query_key_value.weight",
+                         _t_neox_qkv(2)),
+        "attn.bq": Param("gpt_neox.layers.{l}.attention.query_key_value.bias",
+                         _t_neox_qkv_bias(0)),
+        "attn.bk": Param("gpt_neox.layers.{l}.attention.query_key_value.bias",
+                         _t_neox_qkv_bias(1)),
+        "attn.bv": Param("gpt_neox.layers.{l}.attention.query_key_value.bias",
+                         _t_neox_qkv_bias(2)),
+        "attn.wo": Param("gpt_neox.layers.{l}.attention.dense.weight", _t_neox_o),
+        "attn.bo": Param("gpt_neox.layers.{l}.attention.dense.bias"),
+        "norm1.scale": Param("gpt_neox.layers.{l}.input_layernorm.weight"),
+        "norm1.bias": Param("gpt_neox.layers.{l}.input_layernorm.bias"),
+        "norm2.scale": Param("gpt_neox.layers.{l}.post_attention_layernorm.weight"),
+        "norm2.bias": Param("gpt_neox.layers.{l}.post_attention_layernorm.bias"),
+        "mlp.wi": Param("gpt_neox.layers.{l}.mlp.dense_h_to_4h.weight", t_linear),
+        "mlp.bi": Param("gpt_neox.layers.{l}.mlp.dense_h_to_4h.bias"),
+        "mlp.wo": Param("gpt_neox.layers.{l}.mlp.dense_4h_to_h.weight", t_linear),
+        "mlp.bo": Param("gpt_neox.layers.{l}.mlp.dense_4h_to_h.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("gpt_neox.embed_in.weight"),
+        "embed.lm_head": Param("embed_out.weight", t_linear),
+        "final_norm.scale": Param("gpt_neox.final_layer_norm.weight"),
+        "final_norm.bias": Param("gpt_neox.final_layer_norm.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            intermediate_size=hf_cfg.intermediate_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            activation="gelu_exact" if hf_cfg.hidden_act == "gelu" else "gelu",
+            norm="layernorm", position="rope",
+            rope_theta=float(_get(hf_cfg, "rotary_emb_base", "rope_theta",
+                                  default=10000.0)),
+            rotary_pct=float(_get(hf_cfg, "rotary_pct", default=0.25)),
+            parallel_block=bool(_get(hf_cfg, "use_parallel_residual",
+                                     default=True)),
+            use_bias=True, tie_embeddings=False,
+            norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-5)))
+
+
 ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "llama": LlamaContainer,
     "mistral": MistralContainer,
@@ -265,6 +342,7 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "qwen2": Qwen2Container,
     "phi3": Phi3Container,
     "opt": OPTContainer,
+    "gptneox": GPTNeoXContainer,
     "gpt2": GPT2Container,
 }
 

@@ -80,13 +80,15 @@ class PagedModelRunner:
             q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
             k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
             v = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wv"].astype(dt))
-            if cfg.use_bias:
+            if cfg.use_bias or cfg.qkv_bias:
                 q = q + lp["attn"]["bq"].astype(dt)
                 k = k + lp["attn"]["bk"].astype(dt)
                 v = v + lp["attn"]["bv"].astype(dt)
             if cfg.position == "rope":
-                q = L.apply_rope(q, pos_safe, inv_freq)
-                k = L.apply_rope(k, pos_safe, inv_freq)
+                q = L.apply_rope(q, pos_safe, inv_freq,
+                                 interleaved=cfg.rope_interleaved)
+                k = L.apply_rope(k, pos_safe, inv_freq,
+                                 interleaved=cfg.rope_interleaved)
             kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
             vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
             if c == 1 and _use_pallas_paged():
@@ -106,13 +108,18 @@ class PagedModelRunner:
             y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
             if cfg.use_bias:
                 y = y + lp["attn"]["bo"].astype(dt)
-            h2 = h + y
-            m_in = L.apply_norm(lp["norm2"], h2, cfg)
+            if cfg.parallel_block:   # NeoX/Falcon: attn and mlp share input
+                m_in = L.apply_norm(lp["norm2"], h, cfg)
+            else:
+                h = h + y
+                m_in = L.apply_norm(lp["norm2"], h, cfg)
             if cfg.is_moe:
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
-            return h2 + mlp_out, (kp, vp)
+            if cfg.parallel_block:
+                return h + y + mlp_out, (kp, vp)
+            return h + mlp_out, (kp, vp)
 
         h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool))
         h = L.apply_norm(params["final_norm"], h, cfg)

@@ -24,11 +24,14 @@ class TransformerConfig:
     head_dim: Optional[int] = None      # None → hidden_size // num_heads
     intermediate_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 4096
-    activation: str = "swiglu"          # "swiglu" | "gelu" | "relu"
+    activation: str = "swiglu"          # "swiglu" | "gelu" | "gelu_exact" | "relu"
     norm: str = "rmsnorm"               # "rmsnorm" | "layernorm"
     position: str = "rope"              # "rope" | "learned"
     position_offset: int = 0            # learned-position index offset (OPT: 2)
     rope_theta: float = 10000.0
+    rotary_pct: float = 1.0             # fraction of head_dim rotated (GPT-NeoX)
+    rope_interleaved: bool = False      # GPT-NeoX/GPT-J (cos,sin per pair) layout
+    parallel_block: bool = False        # h + attn(ln1 h) + mlp(ln2 h) (NeoX/Falcon)
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
     use_bias: bool = False

@@ -56,18 +56,36 @@ def apply_norm(params, x, cfg: TransformerConfig):
 # ---- rotary embeddings --------------------------------------------------
 
 def rope_frequencies(cfg: TransformerConfig):
-    d = cfg.dims_per_head
+    d = int(cfg.dims_per_head * cfg.rotary_pct)  # partial rotary (GPT-NeoX)
+    d -= d % 2
     inv_freq = 1.0 / (cfg.rope_theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
     return inv_freq  # (d/2,)
 
 
-def apply_rope(x, positions, inv_freq):
-    """x: (B, S, H, D); positions: (B, S) int32."""
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B, S, D/2)
+def apply_rope(x, positions, inv_freq, *, interleaved=False):
+    """x: (B, S, H, D); positions: (B, S) int32.
+
+    ``inv_freq`` has rd/2 entries where rd <= D is the rotary span (partial
+    rotary, GPT-NeoX ``rotary_pct``); dims past rd pass through untouched.
+    ``interleaved`` uses the (x0,x1),(x2,x3)... pair layout (GPT-J/NeoX
+    checkpoints) instead of split halves (Llama).
+    """
+    rd = 2 * inv_freq.shape[0]
+    rot = x[..., :rd].astype(jnp.float32)
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B, S, rd/2)
     sin = jnp.sin(angles)[:, :, None, :]
     cos = jnp.cos(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1 = rot[..., 0::2]
+        x2 = rot[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        out = jnp.stack([o1, o2], axis=-1).reshape(rot.shape)
+    else:
+        x1, x2 = jnp.split(rot, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if rd < x.shape[-1]:
+        out = jnp.concatenate([out, x[..., rd:].astype(jnp.float32)], axis=-1)
     return out.astype(x.dtype)
 
 
@@ -118,8 +136,8 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
     if cfg.position == "rope":
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
-        q = apply_rope(q, positions, inv_freq)
-        k = apply_rope(k, positions, inv_freq)
+        q = apply_rope(q, positions, inv_freq, interleaved=cfg.rope_interleaved)
+        k = apply_rope(k, positions, inv_freq, interleaved=cfg.rope_interleaved)
 
     new_cache = None
     if kv_cache is not None:
@@ -184,8 +202,10 @@ def apply_mlp(params, x, cfg: TransformerConfig):
         h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
         if cfg.use_bias:
             h = h + params["bi"].astype(dt)
-        h = jax.nn.relu(h) if cfg.activation == "relu" \
-            else jax.nn.gelu(h, approximate=True)
+        if cfg.activation == "relu":
+            h = jax.nn.relu(h)
+        else:  # "gelu" = tanh approximation (gelu_new); "gelu_exact" = erf
+            h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
     y = jnp.einsum("bsf,fe->bse", h, params["wo"].astype(dt))
     if cfg.use_bias:
         y = y + params["bo"].astype(dt)

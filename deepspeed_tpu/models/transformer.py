@@ -137,12 +137,19 @@ class CausalLM:
         a_in = L.apply_norm(lp["norm1"], h, cfg)
         attn_out, _ = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                         inv_freq=self._inv_freq, segment_ids=segment_ids)
-        h = h + attn_out
-        m_in = L.apply_norm(lp["norm2"], h, cfg)
+        if cfg.parallel_block:
+            # NeoX/Falcon parallel residual: attn and mlp both read the
+            # pre-attention stream; one residual add
+            m_in = L.apply_norm(lp["norm2"], h, cfg)
+        else:
+            h = h + attn_out
+            m_in = L.apply_norm(lp["norm2"], h, cfg)
         if cfg.is_moe:
             mlp_out, aux = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
         else:
             mlp_out, aux = L.apply_mlp(lp["mlp"], m_in, cfg), jnp.zeros((), jnp.float32)
+        if cfg.parallel_block:
+            return h + attn_out + mlp_out, aux
         return h + mlp_out, aux
 
     def embed_fwd(self, embed_params, input_ids, positions=None):
@@ -259,12 +266,17 @@ class CausalLM:
             attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                              inv_freq=self._inv_freq,
                                              kv_cache=(ck, cv), cache_len=cache_len)
-            h = h + attn_out
-            m_in = L.apply_norm(lp["norm2"], h, cfg)
+            if cfg.parallel_block:
+                m_in = L.apply_norm(lp["norm2"], h, cfg)
+            else:
+                h = h + attn_out
+                m_in = L.apply_norm(lp["norm2"], h, cfg)
             if cfg.is_moe:
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+            if cfg.parallel_block:
+                return h + attn_out + mlp_out, kv
             return h + mlp_out, kv
 
         h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))

@@ -161,3 +161,14 @@ def test_resolver_unknown_arch():
 
     with pytest.raises(NotImplementedError):
         resolve_container(FakeCfg())
+
+
+def test_container_gptneox_partial_rotary_parallel_residual():
+    """GPT-NeoX/Pythia: head-interleaved fused QKV split, partial rotary
+    (rotary_pct), parallel attention+MLP residual, exact-erf gelu."""
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+    torch.manual_seed(0)
+    _parity(GPTNeoXForCausalLM(GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, max_position_embeddings=64,
+        rotary_pct=0.25, use_parallel_residual=True)))
