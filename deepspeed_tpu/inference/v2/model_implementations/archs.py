@@ -257,6 +257,75 @@ class GPT2Container(LayerContainer):
             norm_eps=hf_cfg.layer_norm_epsilon)
 
 
+def _t_falcon_q(w, cfg):
+    """Falcon (multi_query) fused query_key_value: rows are
+    [q_head0..q_headH-1, k, v] each of head_dim."""
+    h, d, e = cfg.num_heads, cfg.dims_per_head, cfg.hidden_size
+    q = w.reshape(h + 2, d, e)[:h]             # (h, d, e)
+    return q.transpose(2, 0, 1)
+
+
+def _t_falcon_k(w, cfg):
+    h, d, e = cfg.num_heads, cfg.dims_per_head, cfg.hidden_size
+    k = w.reshape(h + 2, d, e)[h:h + 1]        # (1, d, e)
+    return k.transpose(2, 0, 1)
+
+
+def _t_falcon_v(w, cfg):
+    h, d, e = cfg.num_heads, cfg.dims_per_head, cfg.hidden_size
+    v = w.reshape(h + 2, d, e)[h + 1:]
+    return v.transpose(2, 0, 1)
+
+
+class FalconContainer(LayerContainer):
+    """Falcon-7B style (reference ``falcon/container.py``): multi-query
+    attention (one shared KV head), parallel attention+MLP sharing a SINGLE
+    layernorm — mapped by binding norm1 and norm2 to the same source tensor.
+    """
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_falcon_q),
+        "attn.wk": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_falcon_k),
+        "attn.wv": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_falcon_v),
+        "attn.wo": Param("transformer.h.{l}.self_attention.dense.weight", t_o_heads),
+        "norm1.scale": Param("transformer.h.{l}.input_layernorm.weight"),
+        "norm1.bias": Param("transformer.h.{l}.input_layernorm.bias"),
+        # parallel block with ONE shared norm: same tensor feeds both slots
+        "norm2.scale": Param("transformer.h.{l}.input_layernorm.weight"),
+        "norm2.bias": Param("transformer.h.{l}.input_layernorm.bias"),
+        "mlp.wi": Param("transformer.h.{l}.mlp.dense_h_to_4h.weight", t_linear),
+        "mlp.wo": Param("transformer.h.{l}.mlp.dense_4h_to_h.weight", t_linear),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("transformer.word_embeddings.weight"),
+        "embed.lm_head": Param("lm_head.weight", t_linear, optional=True),
+        "final_norm.scale": Param("transformer.ln_f.weight"),
+        "final_norm.bias": Param("transformer.ln_f.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        if getattr(hf_cfg, "new_decoder_architecture", False):
+            raise NotImplementedError(
+                "falcon new_decoder_architecture (40B+ grouped KV) not mapped yet")
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            num_kv_heads=1 if getattr(hf_cfg, "multi_query", True)
+            else hf_cfg.num_attention_heads,
+            intermediate_size=4 * hf_cfg.hidden_size,
+            max_seq_len=_get(hf_cfg, "max_position_embeddings", default=2048),
+            activation="gelu_exact", norm="layernorm", position="rope",
+            rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
+            parallel_block=bool(_get(hf_cfg, "parallel_attn", default=True)),
+            tie_embeddings=bool(_get(hf_cfg, "tie_word_embeddings", default=True)),
+            norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+
 def _t_neox_qkv(idx):
     """NeoX fused query_key_value is HEAD-interleaved: (heads*3*d, e)."""
 
@@ -343,6 +412,7 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "phi3": Phi3Container,
     "opt": OPTContainer,
     "gptneox": GPTNeoXContainer,
+    "falcon": FalconContainer,
     "gpt2": GPT2Container,
 }
 

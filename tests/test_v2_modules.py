@@ -172,3 +172,14 @@ def test_container_gptneox_partial_rotary_parallel_residual():
         vocab_size=128, hidden_size=32, num_hidden_layers=2,
         num_attention_heads=4, intermediate_size=64, max_position_embeddings=64,
         rotary_pct=0.25, use_parallel_residual=True)))
+
+
+def test_container_falcon_multiquery_shared_norm():
+    """Falcon-7B style: multi-query attention, parallel block with ONE
+    shared layernorm (mapped into both norm slots), fused qkv split."""
+    from transformers import FalconConfig, FalconForCausalLM
+    torch.manual_seed(0)
+    _parity(FalconForCausalLM(FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, multi_query=True, parallel_attn=True,
+        new_decoder_architecture=False, bias=False, alibi=False)))
