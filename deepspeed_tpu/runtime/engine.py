@@ -135,6 +135,13 @@ class DeepSpeedEngine:
         # ---- ZeRO-Infinity layer streaming (params on host / NVMe) ----
         self._infinity = None
         off_p = self._config.zero_config.offload_param
+        if off_p is None or off_p.device == "none":
+            # an enclosing zero.Init(remote_device=...) implies param offload
+            from .zero import _active_init_remote_device
+            rd = _active_init_remote_device()
+            if rd and rd != "none" and self.zero_stage == 3:
+                from .zero.config import DeepSpeedZeroOffloadParamConfig
+                off_p = DeepSpeedZeroOffloadParamConfig(device=rd)
         if self.zero_stage == 3 and off_p is not None and off_p.device != "none":
             self._init_infinity(off_p)
             return

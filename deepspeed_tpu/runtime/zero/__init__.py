@@ -17,10 +17,26 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from .config import DeepSpeedZeroConfig  # noqa: F401
 
 
+_ACTIVE_INIT = None
+
+
+def _active_init_remote_device():
+    """Engine hook: remote_device requested by the enclosing ``zero.Init``."""
+    return None if _ACTIVE_INIT is None else _ACTIVE_INIT.remote_device
+
+
 class Init:
     """API-parity context (reference ``zero.Init``). Model construction under
     this context behaves identically outside it (sharded-at-birth is the
-    default); kwargs are accepted and recorded."""
+    default: ``DeepSpeedEngine`` jits ``model.init`` with ZeRO
+    out-shardings, so the full tensor never materializes on any chip).
+
+    ``remote_device="cpu"|"nvme"`` carries real weight: engines initialized
+    under the context default ``offload_param.device`` to it, so a stage-3
+    model whose fp32 state exceeds device memory boots straight into the
+    ZeRO-Infinity layer-streaming runner (``runtime/zero/infinity.py``) —
+    group-by-group init, masters resident on host/NVMe — the reference
+    ``partition_parameters.py:808`` remote-device path."""
 
     def __init__(self, module=None, data_parallel_group=None, mem_efficient_linear=True,
                  remote_device=None, pin_memory=False, config_dict_or_path=None,
@@ -28,11 +44,19 @@ class Init:
         self.enabled = enabled
         self.config = config_dict_or_path or config
         self.dtype = dtype
+        self.remote_device = remote_device if enabled else None
+        self.pin_memory = pin_memory
+        self._prev = None
 
     def __enter__(self):
+        global _ACTIVE_INIT
+        self._prev = _ACTIVE_INIT
+        _ACTIVE_INIT = self
         return self
 
     def __exit__(self, *exc):
+        global _ACTIVE_INIT
+        _ACTIVE_INIT = self._prev
         return False
 
 

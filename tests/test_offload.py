@@ -285,3 +285,25 @@ def test_native_host_offload_checkpoint_roundtrip(tmp_path, mesh_8dp):
     np.testing.assert_allclose(m_before, m_after, rtol=1e-6)
     loss = float(engine2.train_batch({"input_ids": ids, "labels": ids}))
     assert np.isfinite(loss)
+
+
+def test_zero_init_remote_device_routes_to_infinity(mesh_8dp):
+    """zero.Init(remote_device="cpu") is not a no-op: engines constructed
+    under it boot the ZeRO-Infinity streaming runner (reference
+    partition_parameters.py:808 remote-device semantics)."""
+    from deepspeed_tpu.runtime import zero
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(data=8))
+    model = build_model("tiny")
+    cfg = {"train_batch_size": 8,
+           "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": 3},
+           "steps_per_print": 10 ** 9}
+    with zero.Init(remote_device="cpu"):
+        engine, _, _, _ = ds.initialize(model=model, config=cfg)
+    assert engine._infinity is not None
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (8, 32))
+    loss = float(engine.train_batch({"input_ids": ids, "labels": ids}))
+    assert np.isfinite(loss)
