@@ -108,6 +108,23 @@ class DeepSpeedEngine:
                      and self.mesh.shape.get("zrep", 1) > 1)
         self.param_shardings = shd.tree_shardings(abstract, logical,
                                                   shd.zero_rules(self.zero_stage), self.mesh)
+        if self.zero_stage == 3:
+            # stage3_param_persistence_threshold (reference
+            # partition_parameters.py persisted params): leaves smaller than
+            # the threshold stay replicated over the ZeRO axes — tiny
+            # norms/biases skip the per-layer allgather entirely.
+            zo_dict = self._config._param_dict.get("zero_optimization", {})
+            explicit = ("stage3_param_persistence_threshold" in zo_dict
+                        or "param_persistence_threshold" in zo_dict)
+            thr = int(self._config.zero_config.param_persistence_threshold or 0)
+            if explicit and thr > 0:
+                import math as _math
+                small = shd.tree_shardings(abstract, logical,
+                                           shd.zero_rules(1), self.mesh)
+                self.param_shardings = jax.tree.map(
+                    lambda s3, s1, a: s1 if _math.prod(a.shape) < thr else s3,
+                    self.param_shardings, small, abstract,
+                    is_leaf=lambda x: isinstance(x, NamedSharding))
         self._opt_param_shardings = shd.tree_shardings(
             abstract, logical,
             shd.optimizer_state_rules(self.zero_stage, hpz=self._hpz), self.mesh)

@@ -185,3 +185,35 @@ def test_check_sharded_equivalence_guard():
     ids = rng.integers(0, 256, (16, 32))
     mx, _ = engine.check_sharded_equivalence({"input_ids": ids, "labels": ids})
     assert mx < 1e-4
+
+
+def test_stage3_param_persistence_threshold():
+    """stage3_param_persistence_threshold keeps small leaves replicated
+    (persisted) while large ones stay FSDP-sharded, and training still
+    matches plain DP."""
+    def run(thr):
+        groups.reset_mesh()
+        groups.set_mesh(groups.build_mesh(data=8))
+        model = build_model("tiny")
+        zo = {"stage": 3}
+        if thr:
+            zo["stage3_param_persistence_threshold"] = thr
+        engine, _, _, _ = ds.initialize(model=model, config={
+            "train_batch_size": 16,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+            "zero_optimization": zo, "steps_per_print": 10 ** 9})
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(3):
+            ids = rng.integers(0, 256, (16, 32))
+            losses.append(float(engine.train_batch({"input_ids": ids, "labels": ids})))
+        return losses, engine
+
+    ref, _ = run(0)
+    # threshold above the norm-scale size (64) but below the attention mats
+    got, eng = run(1000)
+    np.testing.assert_allclose(ref, got, rtol=3e-4, atol=3e-4)
+    norm_scale = eng.module_params["final_norm"]["scale"]
+    wq = eng.module_params["layers"]["attn"]["wq"]
+    assert norm_scale.sharding.is_fully_replicated          # persisted
+    assert not wq.sharding.is_fully_replicated              # still sharded
