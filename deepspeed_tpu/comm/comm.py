@@ -296,7 +296,9 @@ class _Coalescer:
                 offs = _np_cumsum(sizes)
                 start = 0
                 for (t, h), end in zip(items, offs):
-                    h._set(per_rank[:, start:end].reshape((n * t.size,)))
+                    # same contract as the direct call: dim-0-tiled original
+                    h._set(per_rank[:, start:end].reshape(
+                        (n * t.shape[0],) + tuple(t.shape[1:])))
                     start = end
             else:
                 raise NotImplementedError(kind)

@@ -82,7 +82,8 @@ struct AioHandle {
         int flags = req.write ? (O_WRONLY | O_CREAT) : O_RDONLY;
         bool direct = false;
 #ifdef O_DIRECT
-        if (use_direct) { flags |= O_DIRECT; direct = true; }
+        // unaligned offsets cannot use O_DIRECT at all — open buffered
+        if (use_direct && req.offset % 4096 == 0) { flags |= O_DIRECT; direct = true; }
 #endif
         int fd = ::open(req.path.c_str(), flags, 0644);
         if (fd < 0 && direct) {  // filesystem may not support O_DIRECT
@@ -114,14 +115,12 @@ struct AioHandle {
 
     // O_DIRECT path: user buffers are arbitrary numpy memory, so stage
     // through a page-aligned bounce buffer (the pinned-buffer-manager role
-    // of the reference's deepspeed_pin_tensor.cpp). Offsets are assumed
-    // block-aligned (the swapper writes whole tensors at offset 0); a
+    // of the reference's deepspeed_pin_tensor.cpp). Only reached for
+    // sector-aligned offsets (do_io opens unaligned requests buffered); a
     // ragged tail is completed with an aligned full-sector transfer for
-    // writes (file extended, then truncated back) and a short read retry
-    // without O_DIRECT for reads.
+    // writes (file extended, then truncated back).
     int do_io_direct(int fd, const Request& req) {
         constexpr int64_t kAlign = 4096;
-        if (req.offset % kAlign != 0) return do_io_buffered(fd, req);
         void* bounce = nullptr;
         int64_t buf_len = block_size < kAlign ? kAlign : block_size;
         if (posix_memalign(&bounce, kAlign, buf_len) != 0) return -1;

@@ -165,15 +165,17 @@ class DistributedDataAnalyzer(DataAnalyzer):
         for k, (name, mt, dt) in enumerate(zip(self.metric_names,
                                                self.metric_types,
                                                self.metric_dtypes)):
-            if mt != SINGLE_VALUE:
-                continue
-            b = MMapIndexedDatasetBuilder(self._shard_prefix(name, self.rank), dt)
-            b.add_item(np.asarray(results[k]).reshape(-1))
-            b.finalize()
+            if mt == SINGLE_VALUE:
+                b = MMapIndexedDatasetBuilder(self._shard_prefix(name, self.rank), dt)
+                b.add_item(np.asarray(results[k]).reshape(-1))
+                b.finalize()
+            else:   # accumulate partials persist too, so rank 0 can sum them
+                np.save(self._shard_prefix(name, self.rank) + "_acc.npy",
+                        np.asarray(results[k]))
         return results
 
     def run_map_reduce(self):
-        results = self.run_map()
+        self.run_map()
         if self.rank != 0:
             return None
         merged = []
@@ -181,7 +183,16 @@ class DistributedDataAnalyzer(DataAnalyzer):
                                                self.metric_types,
                                                self.metric_dtypes)):
             if mt != SINGLE_VALUE:
-                merged.append(results[k])   # caller sums accumulate shards
+                total = None
+                for r in range(self.world_size):
+                    path = self._shard_prefix(name, r) + "_acc.npy"
+                    if not os.path.exists(path):
+                        raise FileNotFoundError(
+                            f"accumulate shard {r} for metric {name} missing — "
+                            f"did every rank run run_map()?")
+                    part = np.load(path)
+                    total = part if total is None else total + part
+                merged.append(total)
                 continue
             parts = []
             for r in range(self.world_size):

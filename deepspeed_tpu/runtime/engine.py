@@ -1097,8 +1097,6 @@ class DeepSpeedEngine:
                                      * self.dp_world_size], batch)
         scale = jnp.float32(1.0)
         sharded_loss, sharded_grads = self._grad_fn(self.module_params, mb, scale)
-
-        rep = self._replicated
         rep_params = jax.device_put(jax.device_get(self.module_params))
 
         @jax.jit
@@ -1119,7 +1117,7 @@ class DeepSpeedEngine:
             err = np_.abs(a - b)
             rel = err / (np_.abs(b) + 1e-8)
             max_abs = max(max_abs, float(err.max()))
-            max_rel = max(max_rel, float(np_.median(rel)))
+            max_rel = max(max_rel, float(rel.max()))
             if not np_.allclose(a, b, rtol=rtol, atol=atol):
                 worst = float(err.max())
                 raise AssertionError(
@@ -1185,7 +1183,11 @@ class DeepSpeedEngine:
             os.makedirs(path, exist_ok=True)
             with open(os.path.join(path, "infinity_state.pkl"), "wb") as f:
                 pickle.dump({"runner": self._infinity.state_dict(),
-                             "meta": {"global_steps": self.global_steps}}, f)
+                             "meta": {"global_steps": self.global_steps,
+                                      "global_samples": self.global_samples,
+                                      "micro_steps": self.micro_steps,
+                                      "skipped_steps": self.skipped_steps,
+                                      "client_state": client_state or {}}}, f)
             if save_latest:
                 with open(os.path.join(save_dir, "latest"), "w") as f:
                     f.write(str(tag))
@@ -1231,8 +1233,12 @@ class DeepSpeedEngine:
             with open(os.path.join(path, "infinity_state.pkl"), "rb") as f:
                 blob = pickle.load(f)
             self._infinity.load_state_dict(blob["runner"])
-            self.global_steps = blob["meta"]["global_steps"]
-            return path, {}
+            meta = blob["meta"]
+            self.global_steps = int(meta["global_steps"])
+            self.global_samples = int(meta.get("global_samples", 0))
+            self.micro_steps = int(meta.get("micro_steps", 0))
+            self.skipped_steps = int(meta.get("skipped_steps", 0))
+            return path, meta.get("client_state", {})
         template = {
             "module": (self.module_params, self.param_shardings),
             "optimizer": (self.opt_state,

@@ -158,3 +158,16 @@ def test_coalescing_manager(mesh_8dp):
             np.testing.assert_allclose(np.asarray(h.wait()), 8.0 * (i + 1))
     finally:
         backend.all_reduce = orig
+
+
+def test_coalescing_manager_all_gather_shape(mesh_8dp):
+    """Coalesced all_gather handles resolve to the same dim-0-tiled shape as
+    the direct call."""
+    import deepspeed_tpu.comm as dist
+    x = jnp.arange(32.0).reshape(8, 4)
+    direct = dist.all_gather_into_tensor(x)
+    with dist.coalescing_manager():
+        h = dist.all_gather_into_tensor(x)
+    out = h.wait()
+    assert out.shape == direct.shape
+    np.testing.assert_allclose(np.asarray(out), np.asarray(direct))

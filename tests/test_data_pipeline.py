@@ -108,20 +108,29 @@ def test_distributed_data_analyzer_matches_single(tmp_path):
     def seqlen(batch):
         return [len(s) for s in batch]
 
-    # every "rank" maps its shard; rank 0 merges
+    def total_tokens(batch):
+        return sum(len(s) for s in batch)
+
+    names = ["seqlen", "total"]
+    fns = [seqlen, total_tokens]
+    types = ["single_value_per_sample", ACCUMULATE]
+    # every "rank" maps its shard; rank 0 merges (incl. accumulate shards)
     for r in range(1, 4):
-        DistributedDataAnalyzer(data, ["seqlen"], [seqlen],
+        DistributedDataAnalyzer(data, names, fns, metric_types=types,
                                 save_path=str(tmp_path / "dist"),
                                 rank=r, world_size=4).run_map()
-    DistributedDataAnalyzer(data, ["seqlen"], [seqlen],
+    DistributedDataAnalyzer(data, names, fns, metric_types=types,
                             save_path=str(tmp_path / "dist"),
                             rank=0, world_size=4).run_map_reduce()
-    DataAnalyzer(data, ["seqlen"], [seqlen],
+    DataAnalyzer(data, names, fns, metric_types=types,
                  save_path=str(tmp_path / "single")).run_map_reduce()
     a = MMapIndexedDataset(str(tmp_path / "dist" / "seqlen_sample_to_metric"))
     b = MMapIndexedDataset(str(tmp_path / "single" / "seqlen_sample_to_metric"))
     for i in range(len(data)):
         assert int(a[i][0]) == int(b[i][0])
+    # accumulate aggregates over ALL ranks' shards, not just rank 0's slice
+    acc = MMapIndexedDataset(str(tmp_path / "dist" / "total_accumulated"))
+    assert int(acc[0][0]) == sum(len(s) for s in data)
 
 
 def test_curriculum_sampler_uses_analysis(tmp_path):
