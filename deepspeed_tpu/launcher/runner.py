@@ -133,7 +133,7 @@ def main(argv=None):
     parser.add_argument("--master_addr", type=str, default=None)
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--launcher", type=str, default="pdsh",
-                        choices=["pdsh", "ssh", "openmpi", "local"])
+                        choices=["pdsh", "ssh", "openmpi", "mpich", "slurm", "local"])
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("--dry_run", action="store_true",
                         help="print the per-node commands without executing")
@@ -160,14 +160,15 @@ def main(argv=None):
         host, cmd = cmds[0]
         return subprocess.call(cmd, shell=True)
 
-    procs = []
-    for host, cmd in cmds:
-        if args.launcher == "pdsh":
-            full = f"pdsh -w {host} {shlex.quote(cmd)}"
-        else:
-            full = f"ssh {host} {shlex.quote(cmd)}"
-        logger.info(f"launching on {host}")
-        procs.append(subprocess.Popen(full, shell=True))
+    from .multinode_runner import build_runner
+    runner = build_runner(args.launcher if args.launcher != "local" else "ssh",
+                          args, active)
+    if not runner.backend_exists():
+        logger.warning(f"{args.launcher} not found on PATH; commands would be:")
+        for c in runner.get_cmd(cmds):
+            logger.warning(f"  {c}")
+        return 1
+    procs = [subprocess.Popen(full, shell=True) for full in runner.get_cmd(cmds)]
     rc = 0
     for p in procs:
         rc |= p.wait()

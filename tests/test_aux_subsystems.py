@@ -358,3 +358,29 @@ def test_autotuner_strategy_integration(monkeypatch):
     assert patch["train_micro_batch_size_per_gpu"] == 4
     assert patch["zero_optimization"]["stage"] == 0
     assert len(at.results) <= 4
+
+
+def test_multinode_runners_build_commands():
+    """Runner family (reference multinode_runner.py): each transport builds
+    the right fan-out invocation from the per-node commands."""
+    from collections import OrderedDict
+    from deepspeed_tpu.launcher.multinode_runner import build_runner
+    import pytest as _pytest
+
+    world = OrderedDict([("h1", [0, 1]), ("h2", [0, 1])])
+    per_node = [("h1", "ENV=1 python -m x"), ("h2", "ENV=1 python -m x")]
+
+    pdsh = build_runner("pdsh", None, world).get_cmd(per_node)
+    assert len(pdsh) == 2 and pdsh[0].startswith("pdsh -S -w h1 ")
+
+    mpi = build_runner("openmpi", None, world).get_cmd(per_node)
+    assert len(mpi) == 1 and "-H h1:2,h2:2" in mpi[0] and "-np 2" in mpi[0]
+
+    slurm = build_runner("slurm", None, world).get_cmd(per_node)
+    assert "--nodes=2" in slurm[0] and "--nodelist=h1,h2" in slurm[0]
+
+    mpich = build_runner("mpich", None, world).get_cmd(per_node)
+    assert "-hosts h1,h2" in mpich[0]
+
+    with _pytest.raises(ValueError):
+        build_runner("nope", None, world)
