@@ -1,0 +1,92 @@
+"""Tiled linear layers for memory-bounded huge projections.
+
+Analog of ``deepspeed/runtime/zero/tiling.py:32`` (TiledLinear): break a
+linear layer's input/output dimensions into tiles processed in sequence so
+peak live memory is one tile's worth — the reference pairs this with ZeRO-3
+so inactive tiles stay partitioned/offloaded; here the tile loop is a
+``lax.scan`` (or ``jax.remat``-style sequencing) so XLA frees each tile's
+intermediates before the next, and tile weights can carry ZeRO shardings
+like any other leaves.
+
+Functional API (no module system):
+
+    params = tiled_linear_init(rng, in_features, out_features,
+                               in_splits=2, out_splits=4)
+    y = tiled_linear_apply(params, x)            # == x @ W + b
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def tiled_linear_init(rng, in_features: int, out_features: int, *,
+                      in_splits: int = 1, out_splits: int = 1,
+                      bias: bool = True, dtype=jnp.float32, stddev: float = 0.02):
+    """Weights stored as (in_splits, out_splits, in_tile, out_tile) — each
+    tile an independent leaf slice so ZeRO-3/offload partitioning applies
+    tile-wise (the reference's memory story)."""
+    if in_features % in_splits or out_features % out_splits:
+        raise ValueError(f"({in_features}, {out_features}) not divisible by "
+                         f"splits ({in_splits}, {out_splits})")
+    it, ot = in_features // in_splits, out_features // out_splits
+    w = jax.random.normal(rng, (in_splits, out_splits, it, ot), jnp.float32) * stddev
+    params = {"w": w.astype(dtype),
+              "meta": {"in_splits": in_splits, "out_splits": out_splits}}
+    if bias:
+        params["b"] = jnp.zeros((out_features,), dtype)
+    return params
+
+
+def tiled_linear_apply(params, x, *, combine_out_splits: bool = True):
+    """x: (..., in_features) → (..., out_features) (or a list of out tiles
+    when ``combine_out_splits=False``, reference kwarg parity).
+
+    The scan over input tiles keeps at most one (in_tile → out) partial sum
+    live; output tiles are computed per slice so a huge out dimension never
+    materializes its full activation unless combined.
+    """
+    w = params["w"]                      # (IS, OS, it, ot)
+    in_splits, out_splits, it, ot = w.shape
+    x_tiles = x.reshape(x.shape[:-1] + (in_splits, it))
+    x_tiles = jnp.moveaxis(x_tiles, -2, 0)           # (IS, ..., it)
+
+    def accum(carry, xs):
+        xt, wt = xs                                  # (..., it), (OS, it, ot)
+        part = jnp.einsum("...i,sio->s...o", xt, wt)
+        return carry + part, None
+
+    out0 = jnp.zeros((out_splits,) + x.shape[:-1] + (ot,), x.dtype)
+    out, _ = jax.lax.scan(accum, out0, (x_tiles, w))
+    outs = [out[s] for s in range(out_splits)]
+    if "b" in params:
+        b_tiles = params["b"].reshape(out_splits, ot)
+        outs = [o + b_tiles[s].astype(o.dtype) for s, o in enumerate(outs)]
+    if not combine_out_splits:
+        return outs
+    return jnp.concatenate(outs, axis=-1)
+
+
+class TiledLinear:
+    """Thin object wrapper matching the reference class shape."""
+
+    def __init__(self, in_features: int, out_features: int, *, bias: bool = True,
+                 in_splits: int = 1, out_splits: int = 1,
+                 combine_out_splits: bool = True, dtype=jnp.float32):
+        self.in_features = in_features
+        self.out_features = out_features
+        self.bias = bias
+        self.in_splits = in_splits
+        self.out_splits = out_splits
+        self.combine_out_splits = combine_out_splits
+        self.dtype = dtype
+
+    def init(self, rng):
+        return tiled_linear_init(rng, self.in_features, self.out_features,
+                                 in_splits=self.in_splits, out_splits=self.out_splits,
+                                 bias=self.bias, dtype=self.dtype)
+
+    def __call__(self, params, x):
+        return tiled_linear_apply(params, x,
+                                  combine_out_splits=self.combine_out_splits)

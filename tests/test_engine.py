@@ -217,3 +217,26 @@ def test_stage3_param_persistence_threshold():
     wq = eng.module_params["layers"]["attn"]["wq"]
     assert norm_scale.sharding.is_fully_replicated          # persisted
     assert not wq.sharding.is_fully_replicated              # still sharded
+
+
+def test_tiled_linear():
+    """TiledLinear (reference runtime/zero/tiling.py:32): tile-sequenced
+    matmul equals the dense projection; out splits can stay uncombined."""
+    from deepspeed_tpu.runtime.zero.tiling import (TiledLinear,
+                                                   tiled_linear_apply,
+                                                   tiled_linear_init)
+    rng = jax.random.PRNGKey(0)
+    p = tiled_linear_init(rng, 16, 24, in_splits=2, out_splits=3)
+    x = jax.random.normal(jax.random.PRNGKey(1), (5, 16))
+    y = np.asarray(tiled_linear_apply(p, x))
+    w = np.asarray(p["w"], np.float32)
+    W = np.concatenate([np.concatenate([w[i, o] for o in range(3)], axis=1)
+                        for i in range(2)], axis=0)
+    ref = np.asarray(x) @ W + np.asarray(p["b"])
+    np.testing.assert_allclose(y, ref, rtol=1e-2, atol=2e-3)  # device matmul precision
+    outs = tiled_linear_apply(p, x, combine_out_splits=False)
+    assert len(outs) == 3 and outs[0].shape == (5, 8)
+    tl = TiledLinear(16, 24, in_splits=2, out_splits=3)
+    np.testing.assert_allclose(np.asarray(tl(p, x)), y)
+    with pytest.raises(ValueError):
+        tiled_linear_init(rng, 15, 24, in_splits=2)
