@@ -1,0 +1,79 @@
+"""Compression technique scheduler.
+
+Analog of ``deepspeed/compression/scheduler.py:12`` (compression_scheduler):
+each technique in the compression config carries a ``schedule_offset``;
+during training the scheduler tracks steps and activates techniques as
+their offsets pass. The reference flips flags on injected modules; here the
+scheduler returns/applies the functional transforms from ``compress.py``
+for whichever techniques are currently live, so the training loop applies
+compression as a pure param transformation at technique boundaries.
+
+Usage::
+
+    sched = CompressionScheduler(ds_config)
+    for batch in loader:
+        newly = sched.step()              # techniques that just activated
+        if newly:
+            params = sched.apply(engine.module_params)
+            engine.module_params = params
+        engine.train_batch(batch)
+"""
+
+from typing import Dict, List
+
+from ..utils.logging import logger
+from .compress import _apply_to_params, fake_quantize, magnitude_prune
+
+WEIGHT_QUANTIZATION = "weight_quantization"
+SPARSE_PRUNING = "sparse_pruning"
+
+_TECHNIQUES = (WEIGHT_QUANTIZATION, SPARSE_PRUNING)
+
+
+class CompressionScheduler:
+    def __init__(self, deepspeed_config: Dict):
+        self.config = deepspeed_config.get("compression_training", {})
+        self.training_steps = 0
+        self._active = {t: False for t in _TECHNIQUES}
+
+    def _offset(self, technique: str) -> int:
+        shared = self.config.get(technique, {}).get("shared_parameters", {})
+        return int(shared.get("schedule_offset", 0))
+
+    def _enabled(self, technique: str) -> bool:
+        shared = self.config.get(technique, {}).get("shared_parameters", {})
+        return bool(shared.get("enabled", False))
+
+    def active_techniques(self) -> List[str]:
+        return [t for t, on in self._active.items() if on]
+
+    def step(self, steps: int = 1) -> List[str]:
+        """Advance the step count; returns techniques that JUST activated
+        (reference check_* methods flipping enabled flags at offset)."""
+        self.training_steps += steps
+        newly = []
+        for t in _TECHNIQUES:
+            if (self._enabled(t) and not self._active[t]
+                    and self.training_steps >= self._offset(t)):
+                self._active[t] = True
+                newly.append(t)
+                logger.info(f"compression: {t} enabled at step {self.training_steps}")
+        return newly
+
+    def apply(self, params):
+        """Apply the currently-active techniques' transforms to ``params``."""
+        if self._active[WEIGHT_QUANTIZATION]:
+            for gname, g in self.config[WEIGHT_QUANTIZATION].get(
+                    "different_groups", {}).items():
+                bits = g.get("params", {}).get("start_bits", 8)
+                mods = g.get("modules", ["attn", "mlp"])
+                params = _apply_to_params(
+                    params, lambda w: fake_quantize(w, int(bits)), mods)
+        if self._active[SPARSE_PRUNING]:
+            for gname, g in self.config[SPARSE_PRUNING].get(
+                    "different_groups", {}).items():
+                dense = float(g.get("params", {}).get("dense_ratio", 0.5))
+                mods = g.get("modules", ["mlp"])
+                params = _apply_to_params(
+                    params, lambda w: magnitude_prune(w, 1.0 - dense), mods)
+        return params

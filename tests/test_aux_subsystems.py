@@ -384,3 +384,30 @@ def test_multinode_runners_build_commands():
 
     with _pytest.raises(ValueError):
         build_runner("nope", None, world)
+
+
+def test_compression_scheduler_offsets(rng):
+    """Techniques activate at their schedule_offset and apply() transforms
+    only the live ones (reference compression/scheduler.py)."""
+    from deepspeed_tpu.compression.scheduler import CompressionScheduler
+    cfg = {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 2},
+            "different_groups": {"g": {"params": {"start_bits": 8},
+                                       "modules": ["mlp"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 5},
+            "different_groups": {"g": {"params": {"dense_ratio": 0.5},
+                                       "modules": ["mlp"]}}},
+    }}
+    sched = CompressionScheduler(cfg)
+    params = {"mlp": {"w": jax.random.normal(rng, (32, 32))}}
+    assert sched.step() == []                       # step 1: nothing yet
+    assert sched.step() == ["weight_quantization"]  # step 2
+    p1 = sched.apply(params)
+    assert float(jnp.sum(p1["mlp"]["w"] == 0.0)) < 32 * 32 * 0.4  # no pruning yet
+    sched.step(3)
+    assert sched.active_techniques() == ["weight_quantization", "sparse_pruning"]
+    p2 = sched.apply(params)
+    zeros = float(jnp.sum(p2["mlp"]["w"] == 0.0))
+    assert zeros >= 32 * 32 * 0.5                   # pruned to dense_ratio
