@@ -106,3 +106,39 @@ def redundancy_clean(model_or_params, deepspeed_config: Dict, mpu=None):
     params["layers"] = jax.tree.map(lambda x: x[keep_idx], params["layers"])
     logger.info(f"layer reduction: kept layers {list(keep)}")
     return params
+
+
+# ---- named recipes -------------------------------------------------------
+
+# Reference recipe presets (docs/blogs: XTC extreme compression = layer
+# reduction + binarized weights + distillation stage; ZeroQuant = fine-
+# grained W8/W4 group quantization). Returned dicts are plain compression
+# configs for init_compression / CompressionScheduler — start points users
+# tune, mirroring the reference's config_templates.
+
+def xtc_recipe(keep_number_layer=6, start_bits=1, schedule_offset=2000):
+    """Extreme compression (XTC): deep layer reduction + 1-bit weights."""
+    return {"compression_training": {
+        "layer_reduction": {"enabled": True,
+                            "keep_number_layer": keep_number_layer},
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True,
+                                  "schedule_offset": schedule_offset},
+            "different_groups": {"xtc_w": {"params": {"start_bits": start_bits},
+                                           "modules": ["attn", "mlp"]}}},
+    }}
+
+
+def zeroquant_recipe(weight_bits=8, schedule_offset=0):
+    """ZeroQuant-style post-training quantization: W8 (or W4) group quant on
+    every projection; activations stay in compute dtype (bf16 on TPU)."""
+    return {"compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True,
+                                  "schedule_offset": schedule_offset},
+            "different_groups": {
+                "zq_attn": {"params": {"start_bits": weight_bits},
+                            "modules": ["attn"]},
+                "zq_mlp": {"params": {"start_bits": weight_bits},
+                           "modules": ["mlp"]}}},
+    }}
