@@ -115,11 +115,29 @@ class SparseSelfAttention:
             self._layouts[seq_len] = jnp.asarray(self.config.make_layout(seq_len))
         return self._layouts[seq_len]
 
-    def __call__(self, q, k, v, causal: Optional[bool] = None):
-        """q/k/v: (B, S, H, D) → (B, S, H, D)."""
+    def __call__(self, q, k, v, causal: Optional[bool] = None,
+                 use_kernel: Optional[bool] = None):
+        """q/k/v: (B, S, H, D) → (B, S, H, D).
+
+        ``use_kernel`` (default: auto — TPU with a tile-divisible sequence)
+        routes the forward through the block-skipping Pallas splash kernel
+        (``ops/pallas/sparse_flash.py``): cost and memory scale with active
+        blocks instead of S². The dense masked form remains the fallback
+        and the backward pass."""
         s = q.shape[1]
         block = self.config.block
         assert s % block == 0, f"seq {s} not divisible by block {block}"
+        is_causal = bool(causal or self.config.attention == "unidirectional")
+        if use_kernel is None:
+            import jax as _jax
+            from .pallas.sparse_flash import TILE_Q
+            use_kernel = (_jax.default_backend() == "tpu"
+                          and s % TILE_Q == 0 and s >= TILE_Q)
+        if use_kernel:
+            from .pallas.sparse_flash import sparse_flash_attention
+            return sparse_flash_attention(
+                q, k, v, self.config.make_layout(s), layout_block=block,
+                causal=is_causal)
         layout = self.layout(s)                                   # (n, n) blocks
         token_mask = jnp.repeat(jnp.repeat(layout, block, 0), block, 1)  # (S, S)
         if causal or self.config.attention == "unidirectional":
