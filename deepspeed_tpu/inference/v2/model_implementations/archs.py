@@ -403,6 +403,47 @@ class GPTNeoXContainer(LayerContainer):
             norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-5)))
 
 
+class GPTJContainer(LayerContainer):
+    """GPT-J: interleaved partial rotary, parallel block with ONE shared
+    layernorm, no attention biases but biased MLP (``mlp_bias``)."""
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.h.{l}.attn.q_proj.weight", t_q_heads),
+        "attn.wk": Param("transformer.h.{l}.attn.k_proj.weight", t_kv_heads),
+        "attn.wv": Param("transformer.h.{l}.attn.v_proj.weight", t_kv_heads),
+        "attn.wo": Param("transformer.h.{l}.attn.out_proj.weight", t_o_heads),
+        "norm1.scale": Param("transformer.h.{l}.ln_1.weight"),
+        "norm1.bias": Param("transformer.h.{l}.ln_1.bias"),
+        "norm2.scale": Param("transformer.h.{l}.ln_1.weight"),   # shared norm
+        "norm2.bias": Param("transformer.h.{l}.ln_1.bias"),
+        "mlp.wi": Param("transformer.h.{l}.mlp.fc_in.weight", t_linear),
+        "mlp.bi": Param("transformer.h.{l}.mlp.fc_in.bias"),
+        "mlp.wo": Param("transformer.h.{l}.mlp.fc_out.weight", t_linear),
+        "mlp.bo": Param("transformer.h.{l}.mlp.fc_out.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("transformer.wte.weight"),
+        "embed.lm_head": Param("lm_head.weight", t_linear),
+        "embed.lm_head_bias": Param("lm_head.bias", optional=True),
+        "final_norm.scale": Param("transformer.ln_f.weight"),
+        "final_norm.bias": Param("transformer.ln_f.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        d = hf_cfg.n_embd // hf_cfg.n_head
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.n_embd,
+            num_layers=hf_cfg.n_layer, num_heads=hf_cfg.n_head,
+            intermediate_size=_get(hf_cfg, "n_inner", default=4 * hf_cfg.n_embd),
+            max_seq_len=hf_cfg.n_positions,
+            activation="gelu", norm="layernorm", position="rope",
+            rotary_pct=(_get(hf_cfg, "rotary_dim", default=d) or d) / d,
+            rope_interleaved=True, parallel_block=True,
+            use_bias=False, mlp_bias=True, tie_embeddings=False,
+            norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+
 ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "llama": LlamaContainer,
     "mistral": MistralContainer,
@@ -413,6 +454,7 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "opt": OPTContainer,
     "gptneox": GPTNeoXContainer,
     "falcon": FalconContainer,
+    "gptj": GPTJContainer,
     "gpt2": GPT2Container,
 }
 

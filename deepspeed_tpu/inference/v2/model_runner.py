@@ -130,6 +130,8 @@ class PagedModelRunner:
             logits = jnp.einsum("be,ve->bv", h_last, params["embed"]["tok"].astype(dt))
         else:
             logits = jnp.einsum("be,ev->bv", h_last, params["embed"]["lm_head"].astype(dt))
+        if "lm_head_bias" in params["embed"]:
+            logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
         return logits.astype(jnp.float32), kpool, vpool
 
     def _build_decode_loop(self):

@@ -36,6 +36,7 @@ class TransformerConfig:
     tie_embeddings: bool = False
     use_bias: bool = False
     qkv_bias: bool = False              # bias on q/k/v only (Qwen2)
+    mlp_bias: Optional[bool] = None     # None → use_bias (GPT-J: mlp-only biases)
     causal: bool = True
     # MoE (Mixtral-style; 0 experts → dense)
     num_experts: int = 0

@@ -186,7 +186,8 @@ def init_mlp(rng, cfg: TransformerConfig):
             "wo": _normal(r[2], (f, e), cfg.p_dtype, std / math.sqrt(2 * cfg.num_layers)),
         }
         axes = {"wi": ("embed", "mlp"), "wo": ("mlp", "embed")}
-    if cfg.use_bias:
+    mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
+    if mlp_bias:
         params.update(bi=_zeros((f,), cfg.p_dtype), bo=_zeros((e,), cfg.p_dtype))
         axes.update(bi=("mlp",), bo=("embed",))
     return params, axes
@@ -194,20 +195,21 @@ def init_mlp(rng, cfg: TransformerConfig):
 
 def apply_mlp(params, x, cfg: TransformerConfig):
     dt = cfg.act_dtype
+    mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
     if cfg.activation == "swiglu":
         g = jnp.einsum("bse,ef->bsf", x, params["wi_gate"].astype(dt))
         u = jnp.einsum("bse,ef->bsf", x, params["wi_up"].astype(dt))
         h = jax.nn.silu(g) * u
     else:
         h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
-        if cfg.use_bias:
+        if mlp_bias:
             h = h + params["bi"].astype(dt)
         if cfg.activation == "relu":
             h = jax.nn.relu(h)
         else:  # "gelu" = tanh approximation (gelu_new); "gelu_exact" = erf
             h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
     y = jnp.einsum("bsf,fe->bse", h, params["wo"].astype(dt))
-    if cfg.use_bias:
+    if mlp_bias:
         y = y + params["bo"].astype(dt)
     return y
 

@@ -233,6 +233,8 @@ class CausalLM:
             logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
         else:
             logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
+        if "lm_head_bias" in params["embed"]:   # GPT-J style biased head
+            logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
         if return_aux_loss:
             return logits, aux_total
         return logits
@@ -285,6 +287,8 @@ class CausalLM:
             logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
         else:
             logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
+        if "lm_head_bias" in params["embed"]:
+            logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
         return logits, {"k": new_k, "v": new_v}
 
     # -- loss --

@@ -183,3 +183,15 @@ def test_container_falcon_multiquery_shared_norm():
         vocab_size=128, hidden_size=32, num_hidden_layers=2,
         num_attention_heads=4, multi_query=True, parallel_attn=True,
         new_decoder_architecture=False, bias=False, alibi=False)))
+
+
+def test_container_gptj_shared_norm_biased_head():
+    """GPT-J: interleaved partial rotary, parallel block sharing one
+    layernorm, MLP-only biases, biased LM head."""
+    from transformers import GPTJConfig, GPTJForCausalLM
+    torch.manual_seed(0)
+    m = GPTJForCausalLM(GPTJConfig(vocab_size=128, n_embd=32, n_layer=2,
+                                   n_head=4, n_positions=64, rotary_dim=4))
+    with torch.no_grad():
+        m.lm_head.bias.normal_()
+    _parity(m)
