@@ -37,16 +37,23 @@ class QuantizedParameter:
         if bits == 8:
             q, s = quantize_int8(flat, group_size)
             return cls(q, s, w.shape, 8, group_size, w.dtype)
+        if bits == 6:
+            q, s = _quantize_fp6(flat, group_size)
+            return cls(q, s, w.shape, 6, group_size, w.dtype)
         if bits == 4:
             q, s, _ = quantize_int4(flat, group_size)
             return cls(q, s, w.shape, 4, group_size, w.dtype)
-        raise ValueError(f"bits must be 4 or 8, got {bits}")
+        raise ValueError(f"bits must be 4, 6 or 8, got {bits}")
 
     def dequantized(self):
         import math
         n = math.prod(self.orig_shape)
         if self.bits == 8:
             full = dequantize_int8(self.q, self.scales, self.dtype, self.group_size)
+        elif self.bits == 6:
+            padded = ((n + self.group_size - 1) // self.group_size) * self.group_size
+            full = _dequantize_fp6(self.q, self.scales, padded, self.dtype,
+                                   self.group_size)
         else:
             padded = ((n + self.group_size - 1) // self.group_size) * self.group_size
             full = dequantize_int4(self.q, self.scales, (padded,), self.dtype,
@@ -55,7 +62,57 @@ class QuantizedParameter:
 
     @property
     def nbytes(self):
-        return self.q.size * (1 if self.bits == 8 else 1) + self.scales.size * 4
+        return self.q.size + self.scales.size * 4
+
+
+# ---- FP6 (e3m2) weight-only format ---------------------------------------
+# Analog of the reference's FP6 mixed-input GEMM weights
+# (inference/v2/kernels/core_ops/cuda_linear/linear_kernels_cuda.cu): sign +
+# 3-bit exponent (bias 3) + 2-bit mantissa, per-group absmax scaling to the
+# format's max magnitude (28.0); four 6-bit codes pack into three bytes.
+# Encoding is nearest-neighbor over the 64-entry codebook (weights quantize
+# once at load; decode is a vectorized table lookup).
+
+def _fp6_codebook():
+    vals = []
+    for code in range(64):
+        s = -1.0 if code & 0x20 else 1.0
+        e = (code >> 2) & 0x7
+        m = code & 0x3
+        if e == 0:                       # subnormal: 2^-2 * m/4
+            v = 0.25 * (m / 4.0)
+        else:
+            v = (2.0 ** (e - 3)) * (1.0 + m / 4.0)
+        vals.append(s * v)
+    return jnp.asarray(vals, jnp.float32)          # max magnitude 28.0
+
+
+_FP6_MAX = 28.0
+
+
+def _quantize_fp6(flat, group_size):
+    book = _fp6_codebook()
+    g = flat.reshape(-1, group_size).astype(jnp.float32)
+    scales = jnp.max(jnp.abs(g), axis=1, keepdims=True) / _FP6_MAX
+    scales = jnp.maximum(scales, 1e-12)
+    x = g / scales
+    codes = jnp.argmin(jnp.abs(x[..., None] - book[None, None, :]),
+                       axis=-1).astype(jnp.uint8)          # (G, gs)
+    c = codes.reshape(-1, 4).astype(jnp.uint32)            # pack 4 → 3 bytes
+    word = (c[:, 0] | (c[:, 1] << 6) | (c[:, 2] << 12) | (c[:, 3] << 18))
+    packed = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF],
+                       axis=1).astype(jnp.uint8).reshape(-1)
+    return packed, scales.reshape(-1)
+
+
+def _dequantize_fp6(packed, scales, n_padded, dtype, group_size):
+    book = _fp6_codebook()
+    b = packed.reshape(-1, 3).astype(jnp.uint32)
+    word = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
+    codes = jnp.stack([word & 0x3F, (word >> 6) & 0x3F, (word >> 12) & 0x3F,
+                       (word >> 18) & 0x3F], axis=1).reshape(-1)
+    vals = book[codes].reshape(-1, group_size)
+    return (vals * scales[:, None]).astype(dtype).reshape(-1)[:n_padded]
 
 
 class QuantizedLinear:

@@ -104,3 +104,22 @@ def test_zero_init_and_gathered_params(rng):
     assert not tok.sharding.is_fully_replicated
     with ds.zero.GatheredParameters({"tok": tok}) as full:
         assert full["tok"].sharding.is_fully_replicated
+
+
+def test_fp6_weight_only_quantization():
+    """FP6 (e3m2) weight-only format (reference v2 cuda_linear FP6 GEMM):
+    4 codes pack into 3 bytes, per-group absmax scaling, dequant through the
+    64-entry codebook. Representable values round-trip exactly."""
+    from deepspeed_tpu.inference.quantization.layers import QuantizedParameter
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.normal(size=(512, 256)) * 0.05, jnp.float32)
+    qp = QuantizedParameter.quantize(w, bits=6, group_size=256)
+    deq = qp.dequantized()
+    rel = float(jnp.sqrt(jnp.mean((deq - w) ** 2)) / jnp.sqrt(jnp.mean(w ** 2)))
+    assert rel < 0.08            # 2-bit mantissa noise floor
+    assert qp.nbytes < w.size    # < 1 byte per weight, packed
+    # values on the fp6 grid round-trip exactly (x1 scale group)
+    exact = jnp.asarray([[28.0, -1.75, 0.25 * 0.5, 0.0]])
+    qp2 = QuantizedParameter.quantize(exact, bits=6, group_size=4)
+    np.testing.assert_allclose(np.asarray(qp2.dequantized()), np.asarray(exact),
+                               atol=1e-6)
