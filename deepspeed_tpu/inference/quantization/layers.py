@@ -91,13 +91,22 @@ _FP6_MAX = 28.0
 
 
 def _quantize_fp6(flat, group_size):
+    # Codes 0..31 are the non-negative codebook values in ascending order
+    # (monotone in (e, m)), so round-to-nearest is a searchsorted against
+    # the midpoints — O(n log 32), no (n, 64) distance tensor (a 64x fp32
+    # blow-up that would OOM on multi-GB weights at load time).
     book = _fp6_codebook()
+    pos = book[:32]
+    mids = (pos[:-1] + pos[1:]) * 0.5
     g = flat.reshape(-1, group_size).astype(jnp.float32)
     scales = jnp.max(jnp.abs(g), axis=1, keepdims=True) / _FP6_MAX
     scales = jnp.maximum(scales, 1e-12)
-    x = g / scales
-    codes = jnp.argmin(jnp.abs(x[..., None] - book[None, None, :]),
-                       axis=-1).astype(jnp.uint8)          # (G, gs)
+    x = (g / scales).reshape(-1)
+    mag = jnp.searchsorted(mids, jnp.abs(x)).astype(jnp.uint8)
+    codes = jnp.where(x < 0, mag | 0x20, mag).astype(jnp.uint8)
+    pad4 = (-codes.size) % 4                               # pack needs 4 | n
+    if pad4:
+        codes = jnp.concatenate([codes, jnp.zeros((pad4,), codes.dtype)])
     c = codes.reshape(-1, 4).astype(jnp.uint32)            # pack 4 → 3 bytes
     word = (c[:, 0] | (c[:, 1] << 6) | (c[:, 2] << 12) | (c[:, 3] << 18))
     packed = jnp.stack([word & 0xFF, (word >> 8) & 0xFF, (word >> 16) & 0xFF],
@@ -111,7 +120,7 @@ def _dequantize_fp6(packed, scales, n_padded, dtype, group_size):
     word = b[:, 0] | (b[:, 1] << 8) | (b[:, 2] << 16)
     codes = jnp.stack([word & 0x3F, (word >> 6) & 0x3F, (word >> 12) & 0x3F,
                        (word >> 18) & 0x3F], axis=1).reshape(-1)
-    vals = book[codes].reshape(-1, group_size)
+    vals = book[codes[:n_padded]].reshape(-1, group_size)  # drop pack padding
     return (vals * scales[:, None]).astype(dtype).reshape(-1)[:n_padded]
 
 

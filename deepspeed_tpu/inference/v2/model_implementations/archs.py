@@ -444,7 +444,59 @@ class GPTJContainer(LayerContainer):
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
 
 
+class BloomContainer(LayerContainer):
+    """BLOOM (reference ``module_inject/containers/bloom.py``): ALiBi
+    positions, a layernorm directly after the word embeddings
+    (``embedding_norm``), NeoX-style head-interleaved fused QKV, tied head.
+    """
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_neox_qkv(0)),
+        "attn.wk": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_neox_qkv(1)),
+        "attn.wv": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_neox_qkv(2)),
+        "attn.bq": Param("transformer.h.{l}.self_attention.query_key_value.bias",
+                         _t_neox_qkv_bias(0)),
+        "attn.bk": Param("transformer.h.{l}.self_attention.query_key_value.bias",
+                         _t_neox_qkv_bias(1)),
+        "attn.bv": Param("transformer.h.{l}.self_attention.query_key_value.bias",
+                         _t_neox_qkv_bias(2)),
+        "attn.wo": Param("transformer.h.{l}.self_attention.dense.weight", _t_neox_o),
+        "attn.bo": Param("transformer.h.{l}.self_attention.dense.bias"),
+        "norm1.scale": Param("transformer.h.{l}.input_layernorm.weight"),
+        "norm1.bias": Param("transformer.h.{l}.input_layernorm.bias"),
+        "norm2.scale": Param("transformer.h.{l}.post_attention_layernorm.weight"),
+        "norm2.bias": Param("transformer.h.{l}.post_attention_layernorm.bias"),
+        "mlp.wi": Param("transformer.h.{l}.mlp.dense_h_to_4h.weight", t_linear),
+        "mlp.bi": Param("transformer.h.{l}.mlp.dense_h_to_4h.bias"),
+        "mlp.wo": Param("transformer.h.{l}.mlp.dense_4h_to_h.weight", t_linear),
+        "mlp.bo": Param("transformer.h.{l}.mlp.dense_4h_to_h.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("transformer.word_embeddings.weight"),
+        "embed.emb_norm.scale": Param("transformer.word_embeddings_layernorm.weight"),
+        "embed.emb_norm.bias": Param("transformer.word_embeddings_layernorm.bias"),
+        "final_norm.scale": Param("transformer.ln_f.weight"),
+        "final_norm.bias": Param("transformer.ln_f.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size,
+            hidden_size=_get(hf_cfg, "hidden_size", "n_embed"),
+            num_layers=_get(hf_cfg, "num_hidden_layers", "n_layer"),
+            num_heads=_get(hf_cfg, "num_attention_heads", "n_head"),
+            max_seq_len=_get(hf_cfg, "max_position_embeddings", default=2048),
+            activation="gelu", norm="layernorm", position="alibi",
+            embedding_norm=True, use_bias=True, tie_embeddings=True,
+            norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+
 ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
+    "bloom": BloomContainer,
     "llama": LlamaContainer,
     "mistral": MistralContainer,
     "mixtral": MixtralContainer,

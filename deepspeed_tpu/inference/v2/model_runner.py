@@ -64,6 +64,8 @@ class PagedModelRunner:
             h = h + params["embed"]["pos"].astype(dt)[
                 jnp.clip(positions + cfg.position_offset, 0,
                          params["embed"]["pos"].shape[0] - 1)]
+        if cfg.embedding_norm:   # BLOOM word_embeddings_layernorm
+            h = L.apply_norm(params["embed"]["emb_norm"], h, cfg)
         inv_freq = model._inv_freq
         b_idx = jnp.arange(b)[:, None]                      # (B, 1)
         # positions < 0 mark padding: route their writes to trash block 0
@@ -91,7 +93,7 @@ class PagedModelRunner:
                                  interleaved=cfg.rope_interleaved)
             kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
             vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
-            if c == 1 and _use_pallas_paged():
+            if c == 1 and _use_pallas_paged() and cfg.position != "alibi":
                 # decode: Pallas kernel reads pages in place (no gather)
                 from ...ops.pallas.paged_attention import paged_decode_attention
                 out = paged_decode_attention(
@@ -194,6 +196,10 @@ def _paged_attention(q, kpages, vpages, positions, cfg):
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kpages,
                         preferred_element_type=jnp.float32) * (d ** -0.5)
+    if cfg.position == "alibi":
+        # gathered page slot index IS the absolute sequence position
+        logits = logits + L.alibi_bias(
+            cfg.num_heads, jnp.maximum(positions, 0), jnp.arange(kpages.shape[1]))
     k_pos = jnp.arange(kpages.shape[1])[None, None, :]
     mask = k_pos <= positions[:, :, None]               # (B, C, S_pad); pad rows all-False
     logits = jnp.where(mask[:, None], logits, jnp.finfo(jnp.float32).min)

@@ -26,13 +26,14 @@ class TransformerConfig:
     max_seq_len: int = 4096
     activation: str = "swiglu"          # "swiglu" | "gelu" | "gelu_exact" | "relu"
     norm: str = "rmsnorm"               # "rmsnorm" | "layernorm"
-    position: str = "rope"              # "rope" | "learned"
+    position: str = "rope"              # "rope" | "learned" | "alibi"
     position_offset: int = 0            # learned-position index offset (OPT: 2)
     rope_theta: float = 10000.0
     rotary_pct: float = 1.0             # fraction of head_dim rotated (GPT-NeoX)
     rope_interleaved: bool = False      # GPT-NeoX/GPT-J (cos,sin per pair) layout
     parallel_block: bool = False        # h + attn(ln1 h) + mlp(ln2 h) (NeoX/Falcon)
     norm_eps: float = 1e-5
+    embedding_norm: bool = False        # layernorm right after token embed (BLOOM)
     tie_embeddings: bool = False
     use_bias: bool = False
     qkv_bias: bool = False              # bias on q/k/v only (Qwen2)
@@ -120,6 +121,15 @@ PRESETS = {
     "mixtral-8x7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
                                       num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
                                       rope_theta=1e6, num_experts=8, num_experts_per_tok=2),
+    # BLOOM family (ALiBi positions, embedding layernorm, gelu, biases)
+    "bloom-560m": TransformerConfig(vocab_size=250880, hidden_size=1024, num_layers=24, num_heads=16,
+                                    max_seq_len=2048, activation="gelu", norm="layernorm",
+                                    position="alibi", embedding_norm=True, tie_embeddings=True,
+                                    use_bias=True),
+    "bloom-7b1": TransformerConfig(vocab_size=250880, hidden_size=4096, num_layers=30, num_heads=32,
+                                   max_seq_len=2048, activation="gelu", norm="layernorm",
+                                   position="alibi", embedding_norm=True, tie_embeddings=True,
+                                   use_bias=True),
     # tiny variants for tests / CI
     "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
                               intermediate_size=128, max_seq_len=128, param_dtype="float32",

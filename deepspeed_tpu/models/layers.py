@@ -89,6 +89,41 @@ def apply_rope(x, positions, inv_freq, *, interleaved=False):
     return out.astype(x.dtype)
 
 
+# ---- ALiBi --------------------------------------------------------------
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """Per-head ALiBi slopes (Press et al.; the layout HF BLOOM uses).
+
+    For a power-of-two head count: geometric sequence starting at
+    2^(-8/n). Otherwise the closest power of two's sequence is extended
+    with the odd-indexed slopes of the doubled sequence.
+    """
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start ** i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        s = pow2_slopes(num_heads)
+    else:
+        base = 2 ** math.floor(math.log2(num_heads))
+        s = pow2_slopes(base)
+        extra = pow2_slopes(2 * base)[0::2][: num_heads - base]
+        s = s + extra
+    return jnp.asarray(s, jnp.float32)
+
+
+def alibi_bias(num_heads: int, q_pos, k_pos) -> jnp.ndarray:
+    """Additive attention bias slope_h * (k - q): (..., H, Sq, Sk).
+
+    q_pos: (Sq,) or (B, Sq); k_pos: (Sk,). The relative form differs from
+    HF's per-key-position form by a per-row constant, which softmax
+    cancels.
+    """
+    slopes = alibi_slopes(num_heads)                                   # (H,)
+    rel = (k_pos[None, :] - q_pos[..., :, None]).astype(jnp.float32)   # (..., Sq, Sk)
+    return slopes[:, None, None] * rel[..., None, :, :]
+
+
 # ---- attention ----------------------------------------------------------
 
 def init_attention(rng, cfg: TransformerConfig):
@@ -119,11 +154,14 @@ def init_attention(rng, cfg: TransformerConfig):
 
 
 def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_freq=None,
-                    segment_ids=None, kv_cache=None, cache_len=None):
+                    segment_ids=None, kv_cache=None, cache_len=None, attn_bias=None):
     """x: (B, S, E). Returns (out, new_kv_cache).
 
     Training: kv_cache None. Decode: kv_cache = (k, v) with shape
     (B, S_max, KVH, D); new tokens are written at ``cache_len`` offsets.
+    ``attn_bias``: precomputed additive bias (ALiBi) — layer-invariant, so
+    callers scanning over layers build it ONCE and pass it down (computed
+    here only as a standalone-call fallback).
     """
     dt = cfg.act_dtype
     q = jnp.einsum("bse,ehd->bshd", x, params["wq"].astype(dt))
@@ -148,11 +186,19 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
         ck = _scatter_cache(ck, k, idx)
         cv = _scatter_cache(cv, v, idx)
         new_cache = (ck, cv)
-        out = decode_attention(q, ck, cv, cache_len + s)
+        bias = attn_bias
+        if cfg.position == "alibi" and bias is None:
+            k_pos = jnp.arange(ck.shape[1])
+            bias = alibi_bias(cfg.num_heads, idx, k_pos)   # (B, H, S, S_max)
+        out = decode_attention(q, ck, cv, cache_len + s, bias=bias)
     else:
         impl = None if cfg.attn_impl == "auto" else cfg.attn_impl
+        bias = attn_bias
+        if cfg.position == "alibi" and bias is None:
+            pos = jnp.arange(x.shape[1])
+            bias = alibi_bias(cfg.num_heads, pos, pos)[None]  # (1, H, S, S)
         out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids,
-                                  impl=impl)
+                                  bias=bias, impl=impl)
 
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if cfg.use_bias:
@@ -339,6 +385,10 @@ def init_embeddings(rng, cfg: TransformerConfig):
     if cfg.position == "learned":
         params["pos"] = _normal(r[1], (cfg.max_seq_len, cfg.hidden_size), cfg.p_dtype, 0.02)
         axes["pos"] = ("unmodeled", "embed")
+    if cfg.embedding_norm:
+        en, en_axes = init_norm(cfg)
+        params["emb_norm"] = en
+        axes["emb_norm"] = en_axes
     if not cfg.tie_embeddings:
         params["lm_head"] = _normal(r[2], (cfg.hidden_size, cfg.vocab_size), cfg.p_dtype,
                                     cfg.hidden_size ** -0.5)

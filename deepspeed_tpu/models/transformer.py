@@ -132,11 +132,12 @@ class CausalLM:
 
     # -- forward --
 
-    def _layer_fn(self, lp, h, positions, segment_ids):
+    def _layer_fn(self, lp, h, positions, segment_ids, attn_bias=None):
         cfg = self.cfg
         a_in = L.apply_norm(lp["norm1"], h, cfg)
         attn_out, _ = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
-                                        inv_freq=self._inv_freq, segment_ids=segment_ids)
+                                        inv_freq=self._inv_freq, segment_ids=segment_ids,
+                                        attn_bias=attn_bias)
         if cfg.parallel_block:
             # NeoX/Falcon parallel residual: attn and mlp both read the
             # pre-attention stream; one residual add
@@ -161,6 +162,8 @@ class CausalLM:
             if positions is None:
                 positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
             h = h + embed_params["pos"].astype(dt)[positions + cfg.position_offset]
+        if cfg.embedding_norm:   # BLOOM word_embeddings_layernorm
+            h = L.apply_norm(embed_params["emb_norm"], h, cfg)
         return h
 
     def head_loss(self, head_params, h, labels, loss_mask=None):
@@ -203,9 +206,16 @@ class CausalLM:
 
         constrain = _activation_constraint()
 
+        attn_bias = None
+        if cfg.position == "alibi":
+            # layer-invariant: build ONCE outside the scan (inside, remat
+            # boundaries would re-materialize the O(H*S^2) tensor per layer)
+            pos = jnp.arange(input_ids.shape[1])
+            attn_bias = L.alibi_bias(cfg.num_heads, pos, pos)[None]
+
         def body(carry, lp):
             h, aux_sum = carry
-            h, aux = self._layer_fn(lp, h, positions, segment_ids)
+            h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias)
             return (constrain(h), aux_sum + aux), None
 
         if cfg.remat != "none":
@@ -258,16 +268,20 @@ class CausalLM:
         dt = cfg.act_dtype
         b, s = input_ids.shape
         positions = cache_len[:, None] + jnp.arange(s)[None, :]
-        h = params["embed"]["tok"].astype(dt)[input_ids]
-        if cfg.position == "learned":
-            h = h + params["embed"]["pos"].astype(dt)[positions + cfg.position_offset]
+        h = self.embed_fwd(params["embed"], input_ids, positions)
+
+        attn_bias = None
+        if cfg.position == "alibi":
+            attn_bias = L.alibi_bias(cfg.num_heads, positions,
+                                     jnp.arange(cache["k"].shape[2]))
 
         def body(h, layer_in):
             lp, ck, cv = layer_in
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                              inv_freq=self._inv_freq,
-                                             kv_cache=(ck, cv), cache_len=cache_len)
+                                             kv_cache=(ck, cv), cache_len=cache_len,
+                                             attn_bias=attn_bias)
             if cfg.parallel_block:
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
             else:

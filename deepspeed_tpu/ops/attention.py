@@ -74,6 +74,10 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         if not causal:
             raise NotImplementedError("ring attention is causal-only")
         if seq_sharded:
+            if bias is not None:
+                raise NotImplementedError(
+                    "ring attention does not support additive attention bias "
+                    "(ALiBi); use Ulysses SP or attn_impl='reference'")
             return ring_attention(q, k, v, scale=scale)
         # no seq axis: plain local attention
         return reference_attention(q, k, v, causal=causal, bias=bias,
@@ -88,6 +92,11 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
         v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
 
+    if impl == "flash" and bias is not None:
+        raise NotImplementedError(
+            "the Pallas flash kernel does not take an additive attention "
+            "bias (ALiBi); use attn_impl='reference' (auto dispatch already "
+            "routes biased attention there)")
     if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
                            q.shape[3] in (64, 128, 256) and bias is None):
         try:
@@ -119,12 +128,14 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     return out
 
 
-def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
+def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None):
     """Decode/prefill attention against a (B, S_max, KVH, D) KV cache.
 
     q: (B, S_new, H, D) — the S_new query tokens occupy cache slots
     [cache_len - S_new, cache_len); each query attends causally: key slot k
     is visible to query i iff k < cache_len - S_new + i + 1.
+    bias: optional additive (B, H, S_new, S_max) attention bias (ALiBi);
+    bias routes around the fused Pallas kernel.
 
     Single-token decode (S_new == 1) over a LONG cache routes through the
     fused Pallas kernel (``ops/pallas/decode_attention.py`` — the v1
@@ -134,7 +145,7 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
     shorter caches and prefill chunks use the batched XLA einsum below.
     """
     b, s_new, h, d = q.shape
-    if (s_new == 1 and _use_pallas() and k_cache.shape[1] >= 8192
+    if (s_new == 1 and bias is None and _use_pallas() and k_cache.shape[1] >= 8192
             and k_cache.shape[1] % 128 == 0 and d % 64 == 0
             and h % k_cache.shape[2] == 0):
         try:
@@ -160,6 +171,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, scale=None):
         v_cache = jnp.repeat(v_cache, rep, axis=2)
     scale = scale if scale is not None else d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias
     q_pos = (cache_len[:, None] - s_new) + jnp.arange(s_new)[None, :]      # (B, S_new)
     k_pos = jnp.arange(k_cache.shape[1])[None, None, :]                    # (1, 1, S_max)
     mask = k_pos <= q_pos[:, :, None]                                      # (B, S_new, S_max)
