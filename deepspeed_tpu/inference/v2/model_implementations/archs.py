@@ -70,7 +70,15 @@ class LlamaContainer(LayerContainer):
 
 
 class MistralContainer(LlamaContainer):
-    """Mistral shares Llama's graph (reference ``mistral/container.py``)."""
+    """Mistral shares Llama's graph (reference ``mistral/container.py``)
+    plus sliding-window attention."""
+
+    @classmethod
+    def config(cls, hf_cfg):
+        # HF Mistral's sliding mask keeps q-k < W — same convention as
+        # native sliding_window (verified vs eager HF at W < S).
+        return _llama_family_config(
+            hf_cfg, sliding_window=_get(hf_cfg, "sliding_window"))
 
 
 class MixtralContainer(LlamaContainer):
@@ -444,6 +452,122 @@ class GPTJContainer(LayerContainer):
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
 
 
+class PhiContainer(LayerContainer):
+    """Phi-1.5/Phi-2 (reference ``model_implementations/phi``): parallel
+    attention+MLP sharing ONE layernorm, partial rotary, biases everywhere,
+    untied biased LM head."""
+
+    layer_mapping = {
+        "attn.wq": Param("model.layers.{l}.self_attn.q_proj.weight", t_q_heads),
+        "attn.wk": Param("model.layers.{l}.self_attn.k_proj.weight", t_kv_heads),
+        "attn.wv": Param("model.layers.{l}.self_attn.v_proj.weight", t_kv_heads),
+        "attn.bq": Param("model.layers.{l}.self_attn.q_proj.bias", t_q_bias),
+        "attn.bk": Param("model.layers.{l}.self_attn.k_proj.bias", t_kv_bias),
+        "attn.bv": Param("model.layers.{l}.self_attn.v_proj.bias", t_kv_bias),
+        "attn.wo": Param("model.layers.{l}.self_attn.dense.weight", t_o_heads),
+        "attn.bo": Param("model.layers.{l}.self_attn.dense.bias"),
+        "norm1.scale": Param("model.layers.{l}.input_layernorm.weight"),
+        "norm1.bias": Param("model.layers.{l}.input_layernorm.bias"),
+        # parallel block with ONE shared norm (like GPT-J)
+        "norm2.scale": Param("model.layers.{l}.input_layernorm.weight"),
+        "norm2.bias": Param("model.layers.{l}.input_layernorm.bias"),
+        "mlp.wi": Param("model.layers.{l}.mlp.fc1.weight", t_linear),
+        "mlp.bi": Param("model.layers.{l}.mlp.fc1.bias"),
+        "mlp.wo": Param("model.layers.{l}.mlp.fc2.weight", t_linear),
+        "mlp.bo": Param("model.layers.{l}.mlp.fc2.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("model.embed_tokens.weight"),
+        "embed.lm_head": Param("lm_head.weight", t_linear),
+        "embed.lm_head_bias": Param("lm_head.bias", optional=True),
+        "final_norm.scale": Param("model.final_layernorm.weight"),
+        "final_norm.bias": Param("model.final_layernorm.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        if getattr(hf_cfg, "qk_layernorm", False):
+            raise NotImplementedError("phi qk_layernorm variant not mapped")
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            num_kv_heads=_get(hf_cfg, "num_key_value_heads"),
+            intermediate_size=hf_cfg.intermediate_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            activation="gelu", norm="layernorm", position="rope",
+            rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
+            rotary_pct=float(_get(hf_cfg, "partial_rotary_factor", default=0.5)),
+            parallel_block=True, use_bias=True, tie_embeddings=False,
+            norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-5)))
+
+
+class GPTNeoContainer(LayerContainer):
+    """GPT-Neo (reference ``module_inject/containers/gptneo.py``): learned
+    positions, alternating global/local (windowed) attention, un-biased
+    q/k/v with biased out-proj and MLP, tied embeddings."""
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.h.{l}.attn.attention.q_proj.weight", t_q_heads),
+        "attn.wk": Param("transformer.h.{l}.attn.attention.k_proj.weight", t_kv_heads),
+        "attn.wv": Param("transformer.h.{l}.attn.attention.v_proj.weight", t_kv_heads),
+        "attn.wo": Param("transformer.h.{l}.attn.attention.out_proj.weight", t_o_heads),
+        "attn.bo": Param("transformer.h.{l}.attn.attention.out_proj.bias"),
+        "norm1.scale": Param("transformer.h.{l}.ln_1.weight"),
+        "norm1.bias": Param("transformer.h.{l}.ln_1.bias"),
+        "norm2.scale": Param("transformer.h.{l}.ln_2.weight"),
+        "norm2.bias": Param("transformer.h.{l}.ln_2.bias"),
+        "mlp.wi": Param("transformer.h.{l}.mlp.c_fc.weight", t_linear),
+        "mlp.bi": Param("transformer.h.{l}.mlp.c_fc.bias"),
+        "mlp.wo": Param("transformer.h.{l}.mlp.c_proj.weight", t_linear),
+        "mlp.bo": Param("transformer.h.{l}.mlp.c_proj.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("transformer.wte.weight"),
+        "embed.pos": Param("transformer.wpe.weight"),
+        "final_norm.scale": Param("transformer.ln_f.weight"),
+        "final_norm.bias": Param("transformer.ln_f.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        layers = list(getattr(hf_cfg, "attention_layers", []))
+        sliding, every = None, None
+        if "local" in layers:
+            every = layers.index("local") + 1
+            expected = (["global"] * (every - 1) + ["local"]) * \
+                (len(layers) // every) + ["global"] * (len(layers) % every)
+            if layers != expected[:len(layers)]:
+                raise NotImplementedError(
+                    f"irregular gpt-neo attention pattern {layers}")
+            sliding = int(getattr(hf_cfg, "window_size", 256))
+        # GPT-Neo applies NO attention scaling (HF never divides by
+        # sqrt(d)); build_params cancels our 1/sqrt(d) by pre-scaling wq.
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_layers, num_heads=hf_cfg.num_heads,
+            intermediate_size=_get(hf_cfg, "intermediate_size",
+                                   default=4 * hf_cfg.hidden_size),
+            max_seq_len=hf_cfg.max_position_embeddings,
+            activation="gelu", norm="layernorm", position="learned",
+            tie_embeddings=True, use_bias=False, out_bias=True, mlp_bias=True,
+            sliding_window=sliding, local_attention_every=every,
+            norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+    @classmethod
+    def build_params(cls, sd, cfg):
+        import numpy as np
+        params = super().build_params(sd, cfg)
+        # HF GPT-Neo uses unscaled q@k.T; our attention multiplies by
+        # 1/sqrt(d), so pre-scale wq by sqrt(d) to cancel it. Same-dtype
+        # scalar: a float64 python scalar would promote bf16/fp16
+        # checkpoints to float64 under NumPy 2.
+        wq = params["layers"]["attn"]["wq"]
+        params["layers"]["attn"]["wq"] = wq * np.asarray(
+            np.sqrt(cfg.dims_per_head), wq.dtype)
+        return params
+
+
 class BloomContainer(LayerContainer):
     """BLOOM (reference ``module_inject/containers/bloom.py``): ALiBi
     positions, a layernorm directly after the word embeddings
@@ -503,8 +627,10 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "qwen2moe": MixtralContainer,   # qwen2-moe shares the expert layout
     "qwen2": Qwen2Container,
     "phi3": Phi3Container,
+    "phi": PhiContainer,
     "opt": OPTContainer,
     "gptneox": GPTNeoXContainer,
+    "gptneo": GPTNeoContainer,
     "falcon": FalconContainer,
     "gptj": GPTJContainer,
     "gpt2": GPT2Container,

@@ -76,8 +76,13 @@ class PagedModelRunner:
         off = pos_safe % bs
         seq_lens_after = jnp.max(jnp.where(is_pad, 0, positions + 1), axis=1)
 
+        windows = model._layer_windows()   # (L,) for local/global patterns
+
         def layer(h, xs):
-            lp, kp, vp = xs
+            lp, kp, vp, win = xs
+            if win is None and cfg.sliding_window is not None \
+                    and cfg.local_attention_every is None:
+                win = cfg.sliding_window
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
             k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
@@ -93,7 +98,8 @@ class PagedModelRunner:
                                  interleaved=cfg.rope_interleaved)
             kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
             vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
-            if c == 1 and _use_pallas_paged() and cfg.position != "alibi":
+            if (c == 1 and _use_pallas_paged() and cfg.position != "alibi"
+                    and cfg.sliding_window is None):
                 # decode: Pallas kernel reads pages in place (no gather)
                 from ...ops.pallas.paged_attention import paged_decode_attention
                 out = paged_decode_attention(
@@ -106,9 +112,10 @@ class PagedModelRunner:
                     cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
                 # per-query causal mask via positions: query at position p
                 # sees cache slots [0, p]; masks by slot index.
-                out = _paged_attention(q, kpages, vpages, positions, cfg)
+                out = _paged_attention(q, kpages, vpages, positions, cfg,
+                                       window=win)
             y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
-            if cfg.use_bias:
+            if "bo" in lp["attn"]:   # presence-keyed: out_bias may differ from use_bias
                 y = y + lp["attn"]["bo"].astype(dt)
             if cfg.parallel_block:   # NeoX/Falcon: attn and mlp share input
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
@@ -123,7 +130,8 @@ class PagedModelRunner:
                 return h + y + mlp_out, (kp, vp)
             return h + mlp_out, (kp, vp)
 
-        h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool))
+        h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool,
+                                                    windows))
         h = L.apply_norm(params["final_norm"], h, cfg)
         # last valid token of each chunk
         last_idx = jnp.maximum(valid_counts - 1, 0)
@@ -184,9 +192,10 @@ class PagedModelRunner:
         return self._fns[chunk](*args)
 
 
-def _paged_attention(q, kpages, vpages, positions, cfg):
+def _paged_attention(q, kpages, vpages, positions, cfg, window=None):
     """q: (B, C, H, D); kpages/vpages: (B, S_pad, KVH, D); positions: (B, C)
-    absolute slot of each query (−1 = pad). Query at slot p attends slots ≤ p."""
+    absolute slot of each query (−1 = pad). Query at slot p attends slots ≤ p.
+    ``window``: sliding-window width (may be traced; <= 0 = global)."""
     h = q.shape[2]
     kvh = kpages.shape[2]
     if kvh != h:
@@ -202,6 +211,9 @@ def _paged_attention(q, kpages, vpages, positions, cfg):
             cfg.num_heads, jnp.maximum(positions, 0), jnp.arange(kpages.shape[1]))
     k_pos = jnp.arange(kpages.shape[1])[None, None, :]
     mask = k_pos <= positions[:, :, None]               # (B, C, S_pad); pad rows all-False
+    if window is not None:
+        from ...ops.attention import window_mask
+        mask = mask & window_mask(positions[:, :, None], k_pos, window)
     logits = jnp.where(mask[:, None], logits, jnp.finfo(jnp.float32).min)
     # pad queries have no visible keys: softmax over -inf row → uniform; their
     # outputs are discarded by the caller, and max-subtraction keeps it finite.

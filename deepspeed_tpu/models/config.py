@@ -38,7 +38,15 @@ class TransformerConfig:
     use_bias: bool = False
     qkv_bias: bool = False              # bias on q/k/v only (Qwen2)
     mlp_bias: Optional[bool] = None     # None → use_bias (GPT-J: mlp-only biases)
+    out_bias: Optional[bool] = None     # attention out-proj bias override (GPT-Neo)
     causal: bool = True
+    # sliding-window attention: query attends keys in (q-window, q] (Mistral).
+    # local_attention_every=N makes every Nth layer (1-indexed remainder 0...
+    # i.e. layers with index % N == N-1) windowed and the rest global
+    # (GPT-Neo alternates global/local); None with sliding_window set means
+    # ALL layers are windowed.
+    sliding_window: Optional[int] = None
+    local_attention_every: Optional[int] = None
     # MoE (Mixtral-style; 0 experts → dense)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -130,6 +138,15 @@ PRESETS = {
                                    max_seq_len=2048, activation="gelu", norm="layernorm",
                                    position="alibi", embedding_norm=True, tie_embeddings=True,
                                    use_bias=True),
+    # Phi-2 (parallel block sharing one layernorm, partial rotary, biases)
+    "phi-2": TransformerConfig(vocab_size=51200, hidden_size=2560, num_layers=32, num_heads=32,
+                               intermediate_size=10240, max_seq_len=2048, activation="gelu",
+                               norm="layernorm", position="rope", rotary_pct=0.4,
+                               parallel_block=True, use_bias=True),
+    # Mistral-7B (GQA + sliding-window attention)
+    "mistral-7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
+                                    num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
+                                    sliding_window=4096),
     # tiny variants for tests / CI
     "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
                               intermediate_size=128, max_seq_len=128, param_dtype="float32",

@@ -147,14 +147,16 @@ def init_attention(rng, cfg: TransformerConfig):
                       bv=_zeros((kvh, d), cfg.p_dtype))
         axes.update(bq=("heads", "head_dim"), bk=("kv_heads", "head_dim"),
                     bv=("kv_heads", "head_dim"))
-    if cfg.use_bias:
+    out_bias = cfg.use_bias if cfg.out_bias is None else cfg.out_bias
+    if out_bias:
         params.update(bo=_zeros((e,), cfg.p_dtype))
         axes.update(bo=("embed",))
     return params, axes
 
 
 def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_freq=None,
-                    segment_ids=None, kv_cache=None, cache_len=None, attn_bias=None):
+                    segment_ids=None, kv_cache=None, cache_len=None, attn_bias=None,
+                    window=None):
     """x: (B, S, E). Returns (out, new_kv_cache).
 
     Training: kv_cache None. Decode: kv_cache = (k, v) with shape
@@ -162,7 +164,11 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
     ``attn_bias``: precomputed additive bias (ALiBi) — layer-invariant, so
     callers scanning over layers build it ONCE and pass it down (computed
     here only as a standalone-call fallback).
+    ``window``: sliding-window width for this layer (static int, or traced
+    scalar under a scan over mixed local/global layers; <= 0 = global).
     """
+    if window is None and cfg.sliding_window is not None and cfg.local_attention_every is None:
+        window = cfg.sliding_window   # uniform window (Mistral)
     dt = cfg.act_dtype
     q = jnp.einsum("bse,ehd->bshd", x, params["wq"].astype(dt))
     k = jnp.einsum("bse,ehd->bshd", x, params["wk"].astype(dt))
@@ -190,7 +196,7 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
         if cfg.position == "alibi" and bias is None:
             k_pos = jnp.arange(ck.shape[1])
             bias = alibi_bias(cfg.num_heads, idx, k_pos)   # (B, H, S, S_max)
-        out = decode_attention(q, ck, cv, cache_len + s, bias=bias)
+        out = decode_attention(q, ck, cv, cache_len + s, bias=bias, window=window)
     else:
         impl = None if cfg.attn_impl == "auto" else cfg.attn_impl
         bias = attn_bias
@@ -198,10 +204,10 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
             pos = jnp.arange(x.shape[1])
             bias = alibi_bias(cfg.num_heads, pos, pos)[None]  # (1, H, S, S)
         out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids,
-                                  bias=bias, impl=impl)
+                                  bias=bias, window=window, impl=impl)
 
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
-    if cfg.use_bias:
+    if "bo" in params:
         y = y + params["bo"].astype(dt)
     return y, new_cache
 

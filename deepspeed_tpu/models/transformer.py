@@ -132,12 +132,23 @@ class CausalLM:
 
     # -- forward --
 
-    def _layer_fn(self, lp, h, positions, segment_ids, attn_bias=None):
+    def _layer_windows(self):
+        """(L,)-int32 per-layer window array for alternating local/global
+        patterns (GPT-Neo), or None when layers are homogeneous (uniform
+        windows flow through cfg.sliding_window inside apply_attention)."""
+        cfg = self.cfg
+        if cfg.sliding_window is None or not cfg.local_attention_every:
+            return None
+        n = cfg.local_attention_every
+        return jnp.asarray([cfg.sliding_window if i % n == n - 1 else 0
+                            for i in range(cfg.num_layers)], jnp.int32)
+
+    def _layer_fn(self, lp, h, positions, segment_ids, attn_bias=None, window=None):
         cfg = self.cfg
         a_in = L.apply_norm(lp["norm1"], h, cfg)
         attn_out, _ = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                         inv_freq=self._inv_freq, segment_ids=segment_ids,
-                                        attn_bias=attn_bias)
+                                        attn_bias=attn_bias, window=window)
         if cfg.parallel_block:
             # NeoX/Falcon parallel residual: attn and mlp both read the
             # pre-attention stream; one residual add
@@ -213,16 +224,19 @@ class CausalLM:
             pos = jnp.arange(input_ids.shape[1])
             attn_bias = L.alibi_bias(cfg.num_heads, pos, pos)[None]
 
-        def body(carry, lp):
+        windows = self._layer_windows()
+
+        def body(carry, xs):
+            lp, win = xs
             h, aux_sum = carry
-            h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias)
+            h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias, win)
             return (constrain(h), aux_sum + aux), None
 
         if cfg.remat != "none":
             body = jax.checkpoint(body, policy=_remat_policy(cfg.remat))
 
         (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
-                                         params["layers"])
+                                         (params["layers"], windows))
         h = L.apply_norm(params["final_norm"], h, cfg)
         return h, aux_total / cfg.num_layers
 
@@ -275,13 +289,15 @@ class CausalLM:
             attn_bias = L.alibi_bias(cfg.num_heads, positions,
                                      jnp.arange(cache["k"].shape[2]))
 
+        windows = self._layer_windows()
+
         def body(h, layer_in):
-            lp, ck, cv = layer_in
+            lp, ck, cv, win = layer_in
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                              inv_freq=self._inv_freq,
                                              kv_cache=(ck, cv), cache_len=cache_len,
-                                             attn_bias=attn_bias)
+                                             attn_bias=attn_bias, window=win)
             if cfg.parallel_block:
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
             else:
@@ -295,7 +311,8 @@ class CausalLM:
                 return h + attn_out + mlp_out, kv
             return h + mlp_out, kv
 
-        h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"], cache["v"]))
+        h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"],
+                                                   cache["v"], windows))
         h = L.apply_norm(params["final_norm"], h, cfg)
         if cfg.tie_embeddings:
             logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))

@@ -31,10 +31,23 @@ def _use_pallas() -> bool:
     return jax.default_backend() == "tpu"
 
 
-def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None):
+def window_mask(q_pos, k_pos, window):
+    """Sliding-window visibility: key k is visible to query q iff
+    q - k < window; window may be traced, and window <= 0 means global
+    (the sentinel per-layer local/global patterns scan over). Single source
+    of the convention for all three attention engines."""
+    w = jnp.asarray(window, jnp.int32)
+    return (q_pos - k_pos < w) | (w <= 0)
+
+
+def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
+                        window=None):
     """Plain XLA attention: (B, S, H, D) x (B, S, KVH, D) -> (B, S, H, D).
 
     Handles GQA by repeating kv heads. fp32 softmax for stability.
+    ``window``: sliding-window width — query q sees keys in (q-window, q].
+    May be a traced scalar (per-layer local/global patterns under scan);
+    window <= 0 means global.
     """
     b, sq, h, d = q.shape
     kvh = k.shape[2]
@@ -47,10 +60,12 @@ def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     if bias is not None:
         logits = logits + bias
     sk = k.shape[1]
-    if causal:
+    if causal or window is not None:
         q_pos = jnp.arange(sq)[:, None] + (sk - sq)
         k_pos = jnp.arange(sk)[None, :]
-        mask = q_pos >= k_pos
+        mask = q_pos >= k_pos if causal else jnp.ones((sq, sk), bool)
+        if window is not None:
+            mask = mask & window_mask(q_pos, k_pos, window)
         logits = jnp.where(mask[None, None, :, :], logits, jnp.finfo(jnp.float32).min)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]  # (B, Sq, Sk)
@@ -60,12 +75,17 @@ def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
 
 
 def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
-                        impl: Optional[str] = None):
+                        window=None, impl: Optional[str] = None):
     """Dispatching attention entry point.
 
     q: (B, S, H, D); k/v: (B, S, KVH, D). Returns (B, S, H, D).
     impl: None (auto) | "reference" | "flash" | "ulysses"
+    window: sliding-window width (Mistral/GPT-Neo local attention). A
+    static int >= S is a no-op (dropped so flash stays eligible); a traced
+    scalar or a binding window routes to the reference path.
     """
+    if isinstance(window, int) and window >= q.shape[1]:
+        window = None   # cannot bind: every key in range is visible anyway
     mesh = groups.get_mesh() if groups.mesh_is_initialized() else None
     seq_sharded = mesh is not None and mesh.shape.get("seq", 1) > 1
 
@@ -74,14 +94,16 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         if not causal:
             raise NotImplementedError("ring attention is causal-only")
         if seq_sharded:
-            if bias is not None:
+            if bias is not None or window is not None:
                 raise NotImplementedError(
                     "ring attention does not support additive attention bias "
-                    "(ALiBi); use Ulysses SP or attn_impl='reference'")
+                    "(ALiBi) or sliding windows; use Ulysses SP or "
+                    "attn_impl='reference'")
             return ring_attention(q, k, v, scale=scale)
         # no seq axis: plain local attention
         return reference_attention(q, k, v, causal=causal, bias=bias,
-                                   segment_ids=segment_ids, scale=scale)
+                                   segment_ids=segment_ids, scale=scale,
+                                   window=window)
 
     if seq_sharded:
         # Ulysses: swap sequence-sharding for head-sharding around the local
@@ -92,13 +114,15 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
         v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
 
-    if impl == "flash" and bias is not None:
+    if impl == "flash" and (bias is not None or window is not None):
         raise NotImplementedError(
             "the Pallas flash kernel does not take an additive attention "
-            "bias (ALiBi); use attn_impl='reference' (auto dispatch already "
-            "routes biased attention there)")
+            "bias (ALiBi) or a binding sliding window; use "
+            "attn_impl='reference' (auto dispatch already routes these "
+            "there)")
     if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
-                           q.shape[3] in (64, 128, 256) and bias is None):
+                           q.shape[3] in (64, 128, 256) and bias is None and
+                           window is None):
         try:
             from .pallas.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
@@ -118,17 +142,20 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
             if impl == "flash":
                 raise
             out = reference_attention(q, k, v, causal=causal, bias=bias,
-                                      segment_ids=segment_ids, scale=scale)
+                                      segment_ids=segment_ids, scale=scale,
+                                      window=window)
     else:
         out = reference_attention(q, k, v, causal=causal, bias=bias,
-                                  segment_ids=segment_ids, scale=scale)
+                                  segment_ids=segment_ids, scale=scale,
+                                  window=window)
 
     if seq_sharded:
         out = jax.lax.with_sharding_constraint(out, jax.NamedSharding(mesh, out_spec))
     return out
 
 
-def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None):
+def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None,
+                     window=None):
     """Decode/prefill attention against a (B, S_max, KVH, D) KV cache.
 
     q: (B, S_new, H, D) — the S_new query tokens occupy cache slots
@@ -136,6 +163,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None):
     is visible to query i iff k < cache_len - S_new + i + 1.
     bias: optional additive (B, H, S_new, S_max) attention bias (ALiBi);
     bias routes around the fused Pallas kernel.
+    window: sliding-window width (query at slot p sees slots (p-window, p]);
+    may be traced, <= 0 means global.
 
     Single-token decode (S_new == 1) over a LONG cache routes through the
     fused Pallas kernel (``ops/pallas/decode_attention.py`` — the v1
@@ -145,7 +174,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None):
     shorter caches and prefill chunks use the batched XLA einsum below.
     """
     b, s_new, h, d = q.shape
-    if (s_new == 1 and bias is None and _use_pallas() and k_cache.shape[1] >= 8192
+    if (s_new == 1 and bias is None and window is None and _use_pallas()
+            and k_cache.shape[1] >= 8192
             and k_cache.shape[1] % 128 == 0 and d % 64 == 0
             and h % k_cache.shape[2] == 0):
         try:
@@ -176,6 +206,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None):
     q_pos = (cache_len[:, None] - s_new) + jnp.arange(s_new)[None, :]      # (B, S_new)
     k_pos = jnp.arange(k_cache.shape[1])[None, None, :]                    # (1, 1, S_max)
     mask = k_pos <= q_pos[:, :, None]                                      # (B, S_new, S_max)
+    if window is not None:
+        mask = mask & window_mask(q_pos[:, :, None], k_pos, window)
     logits = jnp.where(mask[:, None, :, :], logits, jnp.finfo(jnp.float32).min)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v_cache)

@@ -198,3 +198,32 @@ def test_alibi_attention_biases_distance(mesh_8dp, rng):
         outs.append(logits[:, 0])
     decoded = jnp.stack(outs, axis=1)
     np.testing.assert_allclose(np.asarray(full), np.asarray(decoded), atol=3e-4)
+
+
+def test_sliding_window_decode_matches_full(mesh_8dp, rng):
+    """Sliding-window attention: KV-cache decode must apply the same window
+    mask as the full forward (uniform window and alternating local/global)."""
+    from deepspeed_tpu.models.config import TransformerConfig
+    for every in (None, 2):
+        cfg = TransformerConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                                num_heads=4, intermediate_size=128, max_seq_len=32,
+                                sliding_window=4, local_attention_every=every,
+                                dtype="float32", param_dtype="float32")
+        model = build_model(cfg)
+        params = model.init(rng)
+        ids = jax.random.randint(rng, (2, 12), 0, cfg.vocab_size)
+        full = model.apply(params, ids)
+        # windowed must differ from global attention (the mask binds)
+        glob = build_model(cfg.replace(sliding_window=None)).apply(params, ids)
+        assert np.abs(np.asarray(full) - np.asarray(glob)).max() > 1e-4
+
+        cache = model.init_cache(2, 16)
+        cache_len = jnp.zeros((2,), jnp.int32)
+        outs = []
+        for t in range(12):
+            logits, cache = model.apply_decode(params, ids[:, t:t + 1], cache, cache_len)
+            cache_len = cache_len + 1
+            outs.append(logits[:, 0])
+        decoded = jnp.stack(outs, axis=1)
+        np.testing.assert_allclose(np.asarray(full), np.asarray(decoded), atol=3e-4,
+                                   err_msg=f"local_attention_every={every}")

@@ -237,3 +237,72 @@ def test_bloom_paged_engine_matches_dense():
     dense = np.asarray(v1.generate(prompt, max_new_tokens=6))[0, 12:]
     ragged = v2.generate([prompt[0]], max_new_tokens=6)[0]
     np.testing.assert_array_equal(dense, ragged)
+
+
+def test_container_phi_parallel_block_biased_head():
+    """Phi-1.5/2: parallel attn+mlp sharing one layernorm, partial rotary,
+    biases everywhere, untied biased LM head."""
+    from transformers import PhiConfig, PhiForCausalLM
+    torch.manual_seed(0)
+    m = PhiForCausalLM(PhiConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, partial_rotary_factor=0.5))
+    with torch.no_grad():
+        m.lm_head.bias.normal_()
+    _parity(m)
+
+
+def test_container_gptneo_local_attention():
+    """GPT-Neo: alternating global/local attention with a window SMALLER
+    than the test sequence (so the sliding-window mask must bind), unscaled
+    attention logits, qkv without biases."""
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+    torch.manual_seed(0)
+    m = GPTNeoForCausalLM(GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=5,
+        max_position_embeddings=64))
+    _parity(m)
+
+
+def test_container_mistral_sliding_window_binds():
+    """Mistral with sliding_window < sequence length: the windowed mask must
+    match HF's (a model ignoring the window would diverge)."""
+    from transformers import MistralConfig, MistralForCausalLM
+    torch.manual_seed(0)
+    m = MistralForCausalLM(MistralConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=64, sliding_window=6))
+    from deepspeed_tpu.inference.v2.model_implementations import resolve_container
+    assert resolve_container(m.config).config(m.config).sliding_window == 6
+    _parity(m)
+
+
+def test_gptneo_paged_engine_matches_dense():
+    """GPT-Neo through the v2 paged runner: out-proj bias (present without
+    use_bias) and the per-layer local window must both be applied."""
+    import deepspeed_tpu as ds
+    from transformers import GPTNeoConfig, GPTNeoForCausalLM
+    from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                      RaggedInferenceEngineConfig)
+    torch.manual_seed(2)
+    hf = GPTNeoForCausalLM(GPTNeoConfig(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        attention_types=[[["global", "local"], 1]], window_size=5,
+        max_position_embeddings=64))
+    hf.eval()
+    model, params = build_native(hf, dtype="float32")
+    params = jax.tree.map(jnp.asarray, params)
+
+    v1 = ds.init_inference(model, dtype="float32")
+    v1.module_params = jax.device_put(params, v1.param_shardings)
+
+    cfg = RaggedInferenceEngineConfig(kv_block_size=16, dtype="float32")
+    v2 = InferenceEngineV2(model, cfg, max_seq_len=64, params=jax.device_put(params))
+
+    prompt = np.random.default_rng(0).integers(0, 128, (1, 12))
+    dense = np.asarray(v1.generate(prompt, max_new_tokens=6))[0, 12:]
+    ragged = v2.generate([prompt[0]], max_new_tokens=6)[0]
+    np.testing.assert_array_equal(dense, ragged)
