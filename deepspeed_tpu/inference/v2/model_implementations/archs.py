@@ -452,6 +452,111 @@ class GPTJContainer(LayerContainer):
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
 
 
+class BertContainer(LayerContainer):
+    """BERT (reference ``module_inject/containers/bert.py``): post-norm
+    encoder blocks, token-type embeddings, embedding layernorm, MLM head
+    (transform dense + LN + tied decoder with vocab bias)."""
+
+    from ....models.bert import EncoderLM as model_class
+
+    layer_mapping = {
+        "attn.wq": Param("bert.encoder.layer.{l}.attention.self.query.weight", t_q_heads),
+        "attn.wk": Param("bert.encoder.layer.{l}.attention.self.key.weight", t_kv_heads),
+        "attn.wv": Param("bert.encoder.layer.{l}.attention.self.value.weight", t_kv_heads),
+        "attn.bq": Param("bert.encoder.layer.{l}.attention.self.query.bias", t_q_bias),
+        "attn.bk": Param("bert.encoder.layer.{l}.attention.self.key.bias", t_kv_bias),
+        "attn.bv": Param("bert.encoder.layer.{l}.attention.self.value.bias", t_kv_bias),
+        "attn.wo": Param("bert.encoder.layer.{l}.attention.output.dense.weight", t_o_heads),
+        "attn.bo": Param("bert.encoder.layer.{l}.attention.output.dense.bias"),
+        "norm1.scale": Param("bert.encoder.layer.{l}.attention.output.LayerNorm.weight"),
+        "norm1.bias": Param("bert.encoder.layer.{l}.attention.output.LayerNorm.bias"),
+        "norm2.scale": Param("bert.encoder.layer.{l}.output.LayerNorm.weight"),
+        "norm2.bias": Param("bert.encoder.layer.{l}.output.LayerNorm.bias"),
+        "mlp.wi": Param("bert.encoder.layer.{l}.intermediate.dense.weight", t_linear),
+        "mlp.bi": Param("bert.encoder.layer.{l}.intermediate.dense.bias"),
+        "mlp.wo": Param("bert.encoder.layer.{l}.output.dense.weight", t_linear),
+        "mlp.bo": Param("bert.encoder.layer.{l}.output.dense.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("bert.embeddings.word_embeddings.weight"),
+        "embed.pos": Param("bert.embeddings.position_embeddings.weight"),
+        "embed.type": Param("bert.embeddings.token_type_embeddings.weight"),
+        "embed.emb_norm.scale": Param("bert.embeddings.LayerNorm.weight"),
+        "embed.emb_norm.bias": Param("bert.embeddings.LayerNorm.bias"),
+        "mlm.dense": Param("cls.predictions.transform.dense.weight", t_linear,
+                           optional=True),
+        "mlm.bias": Param("cls.predictions.transform.dense.bias", optional=True),
+        "mlm.norm.scale": Param("cls.predictions.transform.LayerNorm.weight",
+                                optional=True),
+        "mlm.norm.bias": Param("cls.predictions.transform.LayerNorm.bias",
+                               optional=True),
+        "mlm.decoder_bias": Param("cls.predictions.bias", optional=True),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            intermediate_size=hf_cfg.intermediate_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            type_vocab_size=int(_get(hf_cfg, "type_vocab_size", default=2)),
+            activation="gelu_exact", norm="layernorm", position="learned",
+            post_norm=True, causal=False, embedding_norm=True, mlm_head=True,
+            use_bias=True, tie_embeddings=True,
+            norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-12)))
+
+
+class DistilBertContainer(LayerContainer):
+    """DistilBERT (reference ``module_inject/containers/distil_bert.py``):
+    BERT graph without token types; MLM head named vocab_transform/
+    vocab_layer_norm/vocab_projector."""
+
+    from ....models.bert import EncoderLM as model_class
+
+    layer_mapping = {
+        "attn.wq": Param("distilbert.transformer.layer.{l}.attention.q_lin.weight", t_q_heads),
+        "attn.wk": Param("distilbert.transformer.layer.{l}.attention.k_lin.weight", t_kv_heads),
+        "attn.wv": Param("distilbert.transformer.layer.{l}.attention.v_lin.weight", t_kv_heads),
+        "attn.bq": Param("distilbert.transformer.layer.{l}.attention.q_lin.bias", t_q_bias),
+        "attn.bk": Param("distilbert.transformer.layer.{l}.attention.k_lin.bias", t_kv_bias),
+        "attn.bv": Param("distilbert.transformer.layer.{l}.attention.v_lin.bias", t_kv_bias),
+        "attn.wo": Param("distilbert.transformer.layer.{l}.attention.out_lin.weight", t_o_heads),
+        "attn.bo": Param("distilbert.transformer.layer.{l}.attention.out_lin.bias"),
+        "norm1.scale": Param("distilbert.transformer.layer.{l}.sa_layer_norm.weight"),
+        "norm1.bias": Param("distilbert.transformer.layer.{l}.sa_layer_norm.bias"),
+        "norm2.scale": Param("distilbert.transformer.layer.{l}.output_layer_norm.weight"),
+        "norm2.bias": Param("distilbert.transformer.layer.{l}.output_layer_norm.bias"),
+        "mlp.wi": Param("distilbert.transformer.layer.{l}.ffn.lin1.weight", t_linear),
+        "mlp.bi": Param("distilbert.transformer.layer.{l}.ffn.lin1.bias"),
+        "mlp.wo": Param("distilbert.transformer.layer.{l}.ffn.lin2.weight", t_linear),
+        "mlp.bo": Param("distilbert.transformer.layer.{l}.ffn.lin2.bias"),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("distilbert.embeddings.word_embeddings.weight"),
+        "embed.pos": Param("distilbert.embeddings.position_embeddings.weight"),
+        "embed.emb_norm.scale": Param("distilbert.embeddings.LayerNorm.weight"),
+        "embed.emb_norm.bias": Param("distilbert.embeddings.LayerNorm.bias"),
+        "mlm.dense": Param("vocab_transform.weight", t_linear, optional=True),
+        "mlm.bias": Param("vocab_transform.bias", optional=True),
+        "mlm.norm.scale": Param("vocab_layer_norm.weight", optional=True),
+        "mlm.norm.bias": Param("vocab_layer_norm.bias", optional=True),
+        "mlm.decoder_bias": Param("vocab_projector.bias", optional=True),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.dim,
+            num_layers=hf_cfg.n_layers, num_heads=hf_cfg.n_heads,
+            intermediate_size=hf_cfg.hidden_dim,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            activation="gelu_exact", norm="layernorm", position="learned",
+            post_norm=True, causal=False, embedding_norm=True, mlm_head=True,
+            use_bias=True, tie_embeddings=True, norm_eps=1e-12)
+
+
 class PhiContainer(LayerContainer):
     """Phi-1.5/Phi-2 (reference ``model_implementations/phi``): parallel
     attention+MLP sharing ONE layernorm, partial rotary, biases everywhere,
@@ -620,6 +725,8 @@ class BloomContainer(LayerContainer):
 
 
 ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
+    "distilbert": DistilBertContainer,
+    "bert": BertContainer,
     "bloom": BloomContainer,
     "llama": LlamaContainer,
     "mistral": MistralContainer,
@@ -649,11 +756,14 @@ def resolve_container(hf_cfg) -> Type[LayerContainer]:
 
 
 def build_native(hf_model, dtype: str = None) -> Tuple[CausalLM, Dict]:
-    """HF model instance → (native CausalLM, scan-ready param pytree)."""
+    """HF model instance → (native model, scan-ready param pytree).
+
+    The container's ``model_class`` picks the native family (CausalLM for
+    decoders, EncoderLM for BERT-style encoders)."""
     container = resolve_container(hf_model.config)
     cfg = container.config(hf_model.config)
     if dtype:
         cfg = cfg.replace(dtype=dtype)
     sd = hf_model.state_dict()
     params = container.build_params(sd, cfg)
-    return CausalLM(cfg), params
+    return container.model_class(cfg), params

@@ -102,10 +102,19 @@ class LayerContainer:
 
     Subclasses define ``layer_mapping`` (native dotted path → Param) and
     ``non_layer_mapping`` (same, ``{l}``-free), plus ``config(hf_cfg)``.
+    ``model_class`` picks the native family (CausalLM by default; BERT-style
+    containers bind EncoderLM).
     """
 
     layer_mapping: Dict[str, Param] = {}
     non_layer_mapping: Dict[str, Param] = {}
+    model_class = None   # resolved lazily to CausalLM; containers may override
+
+    def __init_subclass__(cls, **kw):
+        super().__init_subclass__(**kw)
+        if cls.model_class is None:
+            from ....models.transformer import CausalLM
+            cls.model_class = CausalLM
 
     @classmethod
     def config(cls, hf_cfg) -> TransformerConfig:

@@ -77,12 +77,15 @@ class PagedModelRunner:
         seq_lens_after = jnp.max(jnp.where(is_pad, 0, positions + 1), axis=1)
 
         windows = model._layer_windows()   # (L,) for local/global patterns
+        uniform_window = None
+        if cfg.sliding_window is not None and cfg.local_attention_every is None \
+                and cfg.sliding_window < block_tables.shape[1] * bs:
+            uniform_window = cfg.sliding_window   # binds within this pool
 
         def layer(h, xs):
             lp, kp, vp, win = xs
-            if win is None and cfg.sliding_window is not None \
-                    and cfg.local_attention_every is None:
-                win = cfg.sliding_window
+            if win is None:
+                win = uniform_window
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
             k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
@@ -99,7 +102,7 @@ class PagedModelRunner:
             kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
             vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
             if (c == 1 and _use_pallas_paged() and cfg.position != "alibi"
-                    and cfg.sliding_window is None):
+                    and win is None):
                 # decode: Pallas kernel reads pages in place (no gather)
                 from ...ops.pallas.paged_attention import paged_decode_attention
                 out = paged_decode_attention(

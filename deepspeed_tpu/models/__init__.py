@@ -6,6 +6,7 @@ import jax
 
 from .config import PRESETS, TransformerConfig, get_config  # noqa: F401
 from .transformer import CausalLM, build_model  # noqa: F401
+from .bert import EncoderLM  # noqa: F401
 
 
 class FunctionalModel:

@@ -1,0 +1,144 @@
+"""BERT-family bidirectional encoder with a masked-LM head.
+
+The reference trains BERT-large under ZeRO-1/2 (BASELINE acceptance
+config 2) and serves BERT through kernel injection
+(``module_inject/containers/bert.py``, ``model_implementations/transformers/
+ds_bert.py``). Here the encoder reuses the decoder's layer primitives with
+three twists carried by ``TransformerConfig``: ``post_norm`` (layernorm
+AFTER each residual add), ``causal=False`` (bidirectional attention;
+padding handled by the segment-ids mask), and ``mlm_head`` (dense + gelu +
+layernorm + tied decoder with a vocab bias).
+"""
+
+import jax
+import jax.numpy as jnp
+
+from . import layers as L
+from .config import TransformerConfig
+from .transformer import CausalLM, _axes_of
+
+
+def init_mlm_head(rng, cfg: TransformerConfig):
+    """BERT ``cls.predictions``: transform dense + LN, tied decoder + bias."""
+    e = cfg.hidden_size
+    params = {
+        "dense": L._normal(rng, (e, e), cfg.p_dtype, 0.02),
+        "bias": L._zeros((e,), cfg.p_dtype),
+        "norm": L.init_norm(cfg)[0],
+        "decoder_bias": L._zeros((cfg.vocab_size,), cfg.p_dtype),
+    }
+    axes = {
+        "dense": ("embed", "unmodeled"),
+        "bias": ("embed",),
+        "norm": L.init_norm(cfg)[1],
+        "decoder_bias": ("vocab",),
+    }
+    return params, axes
+
+
+class EncoderLM(CausalLM):
+    """Bidirectional encoder (BERT/DistilBERT) trained with masked-LM loss.
+
+    ``batch``: input_ids, labels (-100 = unmasked/ignored), optional
+    attention_mask (1 = real token) and token_type_ids.
+    """
+
+    def init(self, rng):
+        params = super().init(rng)
+        if self.cfg.mlm_head:
+            r_mlm = jax.random.fold_in(rng, 0x3A)
+            params["mlm"] = init_mlm_head(r_mlm, self.cfg)[0]
+        return params
+
+    def logical_axes(self):
+        axes = super().logical_axes()
+        if self.cfg.mlm_head:
+            axes["mlm"] = _axes_of(lambda r: init_mlm_head(r, self.cfg))
+        return axes
+
+    def _transform(self, params, h):
+        """MLM transform (dense + gelu + LN), presence-gated: checkpoints
+        loaded without a cls head (e.g. classification fine-tunes) skip it."""
+        cfg = self.cfg
+        if not (cfg.mlm_head and "mlm" in params):
+            return h
+        dt = cfg.act_dtype
+        m = params["mlm"]
+        h = jnp.einsum("bse,eo->bso", h, m["dense"].astype(dt)) + m["bias"].astype(dt)
+        h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
+        return L.apply_norm(m["norm"], h, cfg)
+
+    def apply(self, params, input_ids, *, positions=None, segment_ids=None,
+              token_type_ids=None, attention_mask=None, return_aux_loss=False):
+        """input_ids (B, S) → MLM logits (B, S, V)."""
+        cfg = self.cfg
+        dt = cfg.act_dtype
+        if segment_ids is None and attention_mask is not None:
+            # 0/1 padding mask as segment ids: real tokens attend only real
+            # tokens, pads only pads (whose outputs the loss ignores)
+            segment_ids = attention_mask.astype(jnp.int32)
+        h, aux = self.hidden_states(params, input_ids, positions=positions,
+                                    segment_ids=segment_ids,
+                                    token_type_ids=token_type_ids)
+        h = self._transform(params, h)
+        w, transpose = self._lm_head_weight(params)
+        if transpose:
+            logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
+        else:
+            logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
+        if cfg.mlm_head and "mlm" in params:
+            logits = logits + params["mlm"]["decoder_bias"].astype(logits.dtype)
+        if return_aux_loss:
+            return logits, aux
+        return logits
+
+    def head_loss(self, head_params, h, labels, loss_mask=None):
+        """MLM transform + cross-entropy from hidden states; labels use the
+        -100 ignore convention. Routes through the vocab-chunked fused CE
+        (decoder bias folded in as an extra input column) when the (B, S, V)
+        logits would be large — the same memory bound CausalLM.head_loss
+        enforces (bert-large vocab 30k at batch 32 is ~2 GB of fp32 logits).
+        """
+        cfg = self.cfg
+        h = self._transform(head_params, h)
+        mask = (labels != -100).astype(jnp.float32)
+        if loss_mask is not None:
+            mask = mask * loss_mask
+        safe_labels = jnp.maximum(labels, 0)
+        w, transpose = self._lm_head_weight(head_params)
+        wv = w.T if transpose else w                      # (V, E)
+        bias = None
+        if cfg.mlm_head and "mlm" in head_params:
+            bias = head_params["mlm"]["decoder_bias"]
+        logit_bytes = labels.size * cfg.vocab_size * 4
+        if (cfg.loss_chunks > 0 and cfg.vocab_size >= 4096
+                and logit_bytes > cfg.loss_chunk_threshold_bytes):
+            from ..ops.cross_entropy import lm_cross_entropy
+            if bias is not None:
+                # fold the vocab bias into the matmul: logits = [h, 1] @ [W, b]^T
+                ones = jnp.ones(h.shape[:-1] + (1,), h.dtype)
+                h = jnp.concatenate([h, ones], axis=-1)
+                wv = jnp.concatenate([wv, bias[:, None].astype(wv.dtype)], axis=-1)
+            return lm_cross_entropy(h, wv.astype(h.dtype), safe_labels,
+                                    loss_mask=mask, n_chunks=cfg.loss_chunks)
+        dt = cfg.act_dtype
+        logits = jnp.einsum("bse,ve->bsv", h, wv.astype(dt))
+        if bias is not None:
+            logits = logits + bias.astype(logits.dtype)
+        logits = logits.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+        nll = lse - label_logits
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+    def loss(self, params, batch):
+        """Masked-LM cross-entropy over positions where labels != -100."""
+        segment_ids = batch.get("segment_ids")
+        if segment_ids is None and batch.get("attention_mask") is not None:
+            segment_ids = batch["attention_mask"].astype(jnp.int32)
+        h, _ = self.hidden_states(params, batch["input_ids"],
+                                  positions=batch.get("positions"),
+                                  segment_ids=segment_ids,
+                                  token_type_ids=batch.get("token_type_ids"))
+        return self.head_loss(params, h, batch["labels"],
+                              loss_mask=batch.get("loss_mask"))

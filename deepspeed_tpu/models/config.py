@@ -33,7 +33,10 @@ class TransformerConfig:
     rope_interleaved: bool = False      # GPT-NeoX/GPT-J (cos,sin per pair) layout
     parallel_block: bool = False        # h + attn(ln1 h) + mlp(ln2 h) (NeoX/Falcon)
     norm_eps: float = 1e-5
-    embedding_norm: bool = False        # layernorm right after token embed (BLOOM)
+    embedding_norm: bool = False        # layernorm right after token embed (BLOOM/BERT)
+    post_norm: bool = False             # norm AFTER residual add (BERT) vs pre-LN
+    type_vocab_size: int = 0            # token-type (segment) embeddings (BERT)
+    mlm_head: bool = False              # BERT MLM head: dense+gelu+LN+decoder bias
     tie_embeddings: bool = False
     use_bias: bool = False
     qkv_bias: bool = False              # bias on q/k/v only (Qwen2)
@@ -147,6 +150,20 @@ PRESETS = {
     "mistral-7b": TransformerConfig(vocab_size=32000, hidden_size=4096, num_layers=32, num_heads=32,
                                     num_kv_heads=8, intermediate_size=14336, max_seq_len=32768,
                                     sliding_window=4096),
+    # BERT family (post-norm encoder, MLM head; acceptance config 2 trains
+    # bert-large under ZeRO-1/2)
+    "bert-base": TransformerConfig(vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12,
+                                   intermediate_size=3072, max_seq_len=512, type_vocab_size=2,
+                                   activation="gelu_exact", norm="layernorm", position="learned",
+                                   post_norm=True, causal=False, embedding_norm=True,
+                                   mlm_head=True, use_bias=True, tie_embeddings=True,
+                                   norm_eps=1e-12),
+    "bert-large": TransformerConfig(vocab_size=30522, hidden_size=1024, num_layers=24, num_heads=16,
+                                    intermediate_size=4096, max_seq_len=512, type_vocab_size=2,
+                                    activation="gelu_exact", norm="layernorm", position="learned",
+                                    post_norm=True, causal=False, embedding_norm=True,
+                                    mlm_head=True, use_bias=True, tie_embeddings=True,
+                                    norm_eps=1e-12),
     # tiny variants for tests / CI
     "tiny": TransformerConfig(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
                               intermediate_size=128, max_seq_len=128, param_dtype="float32",

@@ -391,6 +391,11 @@ def init_embeddings(rng, cfg: TransformerConfig):
     if cfg.position == "learned":
         params["pos"] = _normal(r[1], (cfg.max_seq_len, cfg.hidden_size), cfg.p_dtype, 0.02)
         axes["pos"] = ("unmodeled", "embed")
+    if cfg.type_vocab_size:
+        params["type"] = _normal(r[1] if cfg.position != "learned" else
+                                 jax.random.fold_in(r[1], 1),
+                                 (cfg.type_vocab_size, cfg.hidden_size), cfg.p_dtype, 0.02)
+        axes["type"] = ("unmodeled", "embed")
     if cfg.embedding_norm:
         en, en_axes = init_norm(cfg)
         params["emb_norm"] = en

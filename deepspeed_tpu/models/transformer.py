@@ -113,8 +113,10 @@ class CausalLM:
         layer_rngs = jax.random.split(r_layers, cfg.num_layers)
         per_layer = [self._init_layer(r)[0] for r in layer_rngs]
         stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
-        fnorm, _ = L.init_norm(cfg)
-        return {"embed": emb, "layers": stacked, "final_norm": fnorm}
+        out = {"embed": emb, "layers": stacked}
+        if not cfg.post_norm:   # post-norm (BERT) normalizes inside each layer
+            out["final_norm"] = L.init_norm(cfg)[0]
+        return out
 
     def abstract_params(self):
         """Shape/dtype tree without allocating (for sharded init)."""
@@ -127,8 +129,10 @@ class CausalLM:
         emb_axes = _axes_of(lambda r: L.init_embeddings(r, cfg))
         layer_axes = _axes_of(self._init_layer)
         stacked_axes = jax.tree.map(lambda a: ("layers",) + a, layer_axes, is_leaf=_is_axes_leaf)
-        norm_axes = _axes_of(lambda r: L.init_norm(cfg))
-        return {"embed": emb_axes, "layers": stacked_axes, "final_norm": norm_axes}
+        out = {"embed": emb_axes, "layers": stacked_axes}
+        if not cfg.post_norm:
+            out["final_norm"] = _axes_of(lambda r: L.init_norm(cfg))
+        return out
 
     # -- forward --
 
@@ -145,6 +149,16 @@ class CausalLM:
 
     def _layer_fn(self, lp, h, positions, segment_ids, attn_bias=None, window=None):
         cfg = self.cfg
+        if cfg.post_norm:
+            # BERT block: norm AFTER each residual add, attention reads the
+            # raw stream
+            attn_out, _ = L.apply_attention(lp["attn"], h, cfg, positions=positions,
+                                            inv_freq=self._inv_freq,
+                                            segment_ids=segment_ids,
+                                            attn_bias=attn_bias, window=window)
+            h = L.apply_norm(lp["norm1"], h + attn_out, cfg)
+            mlp_out = L.apply_mlp(lp["mlp"], h, cfg)
+            return L.apply_norm(lp["norm2"], h + mlp_out, cfg), jnp.zeros((), jnp.float32)
         a_in = L.apply_norm(lp["norm1"], h, cfg)
         attn_out, _ = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                         inv_freq=self._inv_freq, segment_ids=segment_ids,
@@ -164,8 +178,9 @@ class CausalLM:
             return h + attn_out + mlp_out, aux
         return h + mlp_out, aux
 
-    def embed_fwd(self, embed_params, input_ids, positions=None):
-        """Token (+ learned position) embedding lookup: (B, S) → (B, S, E)."""
+    def embed_fwd(self, embed_params, input_ids, positions=None, token_type_ids=None):
+        """Token (+ learned position, + token-type) embedding lookup:
+        (B, S) → (B, S, E)."""
         cfg = self.cfg
         dt = cfg.act_dtype
         h = embed_params["tok"].astype(dt)[input_ids]
@@ -173,7 +188,11 @@ class CausalLM:
             if positions is None:
                 positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
             h = h + embed_params["pos"].astype(dt)[positions + cfg.position_offset]
-        if cfg.embedding_norm:   # BLOOM word_embeddings_layernorm
+        if cfg.type_vocab_size:   # BERT segment embeddings
+            tt = (token_type_ids if token_type_ids is not None
+                  else jnp.zeros_like(input_ids))
+            h = h + embed_params["type"].astype(dt)[tt]
+        if cfg.embedding_norm:   # BLOOM/BERT post-embedding layernorm
             h = L.apply_norm(embed_params["emb_norm"], h, cfg)
         return h
 
@@ -185,7 +204,8 @@ class CausalLM:
         which never materializes the full param tree on device.
         """
         cfg = self.cfg
-        h = L.apply_norm(head_params["final_norm"], h, cfg)
+        if "final_norm" in head_params:   # absent for post-norm encoders
+            h = L.apply_norm(head_params["final_norm"], h, cfg)
         w, transpose = self._lm_head_weight(head_params)
         logit_bytes = (labels.size * cfg.vocab_size
                        * (2 if cfg.act_dtype != jnp.float32 else 4))
@@ -207,11 +227,12 @@ class CausalLM:
             return jnp.mean(nll)
         return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
 
-    def hidden_states(self, params, input_ids, *, positions=None, segment_ids=None):
+    def hidden_states(self, params, input_ids, *, positions=None, segment_ids=None,
+                      token_type_ids=None):
         """Embed + layer stack + final norm: (B, S) → ((B, S, E), aux_loss)."""
         cfg = self.cfg
         dt = cfg.act_dtype
-        h = self.embed_fwd(params["embed"], input_ids, positions)
+        h = self.embed_fwd(params["embed"], input_ids, positions, token_type_ids)
         if cfg.position == "learned" and positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
 
@@ -237,7 +258,8 @@ class CausalLM:
 
         (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
                                          (params["layers"], windows))
-        h = L.apply_norm(params["final_norm"], h, cfg)
+        if not cfg.post_norm:
+            h = L.apply_norm(params["final_norm"], h, cfg)
         return h, aux_total / cfg.num_layers
 
     def _lm_head_weight(self, params):
@@ -375,7 +397,13 @@ class CausalLM:
 
 def build_model(name_or_cfg, **overrides) -> CausalLM:
     if isinstance(name_or_cfg, str):
-        return CausalLM(get_config(name_or_cfg, **overrides))
-    if isinstance(name_or_cfg, TransformerConfig):
-        return CausalLM(name_or_cfg.replace(**overrides) if overrides else name_or_cfg)
-    raise TypeError(f"build_model expects preset name or TransformerConfig, got {type(name_or_cfg)}")
+        cfg = get_config(name_or_cfg, **overrides)
+    elif isinstance(name_or_cfg, TransformerConfig):
+        cfg = name_or_cfg.replace(**overrides) if overrides else name_or_cfg
+    else:
+        raise TypeError(
+            f"build_model expects preset name or TransformerConfig, got {type(name_or_cfg)}")
+    if cfg.mlm_head or not cfg.causal:
+        from .bert import EncoderLM
+        return EncoderLM(cfg)
+    return CausalLM(cfg)

@@ -174,6 +174,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None,
     shorter caches and prefill chunks use the batched XLA einsum below.
     """
     b, s_new, h, d = q.shape
+    if isinstance(window, int) and window >= k_cache.shape[1]:
+        window = None   # cannot bind within this cache
     if (s_new == 1 and bias is None and window is None and _use_pallas()
             and k_cache.shape[1] >= 8192
             and k_cache.shape[1] % 128 == 0 and d % 64 == 0

@@ -95,6 +95,21 @@ def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
                          check_vma=True)
 
 
+def check_pipeline_model_support(cfg):
+    """Loud rejection of model shapes the compiled pipeline does not thread
+    through its stage loop (silent support would train wrong numerics)."""
+    if getattr(cfg, "post_norm", False) or getattr(cfg, "mlm_head", False) \
+            or not getattr(cfg, "causal", True):
+        raise NotImplementedError(
+            "pipeline engine supports causal pre-norm decoders only; "
+            "train BERT-style encoders under ZeRO (DP/TP/SP) instead")
+    if getattr(cfg, "sliding_window", None) is not None \
+            and getattr(cfg, "local_attention_every", None):
+        raise NotImplementedError(
+            "per-layer local/global attention patterns are not threaded "
+            "through pipeline stages; uniform sliding_window is supported")
+
+
 def _pipeline_interface(model):
     """Three-segment protocol a model must satisfy to be pipelined:
     ``embed(other_params, batch_mb) -> h``, ``layer(layer_params, h) -> h``,
@@ -145,6 +160,8 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
     """
     from .schedule import compile_tick_tables
 
+    if hasattr(model, "cfg"):
+        check_pipeline_model_support(model.cfg)
     mesh = groups.get_mesh()
     embed_fn, layer_fn, loss_fn = _pipeline_interface(model)
     if remat:
@@ -325,6 +342,7 @@ def build_pipeline_loss(model, num_stages: int):
     """
     from ...models import layers as L
     cfg = model.cfg
+    check_pipeline_model_support(cfg)
     assert cfg.num_layers % num_stages == 0, \
         f"num_layers={cfg.num_layers} not divisible by pipe={num_stages}"
     layers_per_stage = cfg.num_layers // num_stages

@@ -200,6 +200,16 @@ class InfinityRunner:
             raise NotImplementedError("ZeRO-Infinity streaming requires a native CausalLM")
         if model.cfg.is_moe:
             raise NotImplementedError("ZeRO-Infinity streaming does not support MoE yet")
+        if model.cfg.post_norm or model.cfg.mlm_head or not model.cfg.causal:
+            raise NotImplementedError(
+                "ZeRO-Infinity streaming supports causal pre-norm decoders "
+                "only (its persistent head fabricates final_norm and uses "
+                "the causal head_loss)")
+        if model.cfg.sliding_window is not None and model.cfg.local_attention_every:
+            raise NotImplementedError(
+                "per-layer local/global window patterns are not threaded "
+                "through the Infinity layer-group scan; uniform "
+                "sliding_window is supported")
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg

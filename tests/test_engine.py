@@ -240,3 +240,24 @@ def test_tiled_linear():
     np.testing.assert_allclose(np.asarray(tl(p, x)), y)
     with pytest.raises(ValueError):
         tiled_linear_init(rng, 15, 24, in_splits=2)
+
+
+def test_bert_mlm_training_zero2(mesh_8dp):
+    """Acceptance config 2 analog (BASELINE.md): a BERT-style post-norm
+    encoder trains under ZeRO-2 through deepspeed_tpu.initialize — MLM loss
+    decreases, params/opt state take the stage-2 shardings."""
+    groups.reset_mesh()
+    model = build_model("bert-base", num_layers=2, hidden_size=64, num_heads=4,
+                        intermediate_size=128, vocab_size=256, max_seq_len=32,
+                        dtype="float32", param_dtype="float32")
+    engine, _, _, _ = ds.initialize(model=model, config=_base_config(2))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (16, 32))
+    labels = np.full_like(ids, -100)
+    mask_pos = rng.random(ids.shape) < 0.3
+    labels[mask_pos] = ids[mask_pos]
+    masked = ids.copy()
+    masked[mask_pos] = 1   # [MASK]-style corruption
+    batch = {"input_ids": masked, "labels": labels}
+    losses = [float(engine.train_batch(batch)) for _ in range(10)]
+    assert losses[-1] < losses[0] - 0.5 and all(np.isfinite(losses)), losses

@@ -306,3 +306,89 @@ def test_gptneo_paged_engine_matches_dense():
     dense = np.asarray(v1.generate(prompt, max_new_tokens=6))[0, 12:]
     ragged = v2.generate([prompt[0]], max_new_tokens=6)[0]
     np.testing.assert_array_equal(dense, ragged)
+
+
+def test_container_bert_mlm_parity():
+    """BERT: post-norm encoder, token-type embeddings, embedding layernorm,
+    MLM head — logits parity vs HF BertForMaskedLM."""
+    from transformers import BertConfig, BertForMaskedLM
+    torch.manual_seed(0)
+    m = BertForMaskedLM(BertConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, type_vocab_size=2))
+    m.eval()
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16))
+    tt = np.zeros_like(ids); tt[:, 8:] = 1
+    with torch.no_grad():
+        ref = m(torch.tensor(ids), token_type_ids=torch.tensor(tt)).logits.numpy()
+    model, params = build_native(m, dtype="float32")
+    from deepspeed_tpu.models.bert import EncoderLM
+    assert isinstance(model, EncoderLM)
+    got = np.asarray(model.apply(jax.tree.map(jnp.asarray, params), jnp.asarray(ids),
+                                 token_type_ids=jnp.asarray(tt)))
+    np.testing.assert_allclose(got, ref, atol=5e-3, rtol=1e-2)
+
+
+def test_container_distilbert_mlm_parity():
+    from transformers import DistilBertConfig, DistilBertForMaskedLM
+    torch.manual_seed(0)
+    m = DistilBertForMaskedLM(DistilBertConfig(
+        vocab_size=128, dim=32, n_layers=2, n_heads=4, hidden_dim=64,
+        max_position_embeddings=64))
+    _parity(m)
+
+
+def test_bert_mlm_loss_ignores_unmasked():
+    """MLM loss averages only over labeled (-100-masked-out) positions."""
+    from deepspeed_tpu.models import build_model
+    model = build_model("bert-base", num_layers=2, hidden_size=64, num_heads=4,
+                        intermediate_size=128, vocab_size=256, max_seq_len=32,
+                        dtype="float32", param_dtype="float32")
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 256, (2, 16))
+    labels = np.full_like(ids, -100)
+    labels[:, 3] = ids[:, 3]
+    l1 = float(model.loss(params, {"input_ids": jnp.asarray(ids),
+                                   "labels": jnp.asarray(labels)}))
+    # flipping an ignored label must not change the loss
+    labels2 = labels.copy(); labels2[:, 10] = -100
+    l2 = float(model.loss(params, {"input_ids": jnp.asarray(ids),
+                                   "labels": jnp.asarray(labels2)}))
+    assert np.isfinite(l1) and abs(l1 - l2) < 1e-6
+
+
+def test_bert_chunked_loss_matches_dense():
+    """EncoderLM's vocab-chunked fused CE (decoder bias folded into an extra
+    input column) must match the dense-logit loss."""
+    from deepspeed_tpu.models import build_model
+    model = build_model("bert-base", num_layers=2, hidden_size=64, num_heads=4,
+                        intermediate_size=128, vocab_size=8192, max_seq_len=32,
+                        dtype="float32", param_dtype="float32")
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 8192, (2, 16))
+    labels = np.full_like(ids, -100)
+    pos = rng.random(ids.shape) < 0.3
+    labels[pos] = ids[pos]
+    batch = {"input_ids": jnp.asarray(ids), "labels": jnp.asarray(labels)}
+    dense = float(model.loss(params, batch))        # under threshold: dense
+    model_c = build_model(model.cfg.replace(loss_chunk_threshold_bytes=1))
+    chunked = float(model_c.loss(params, batch))    # forced chunked path
+    np.testing.assert_allclose(dense, chunked, rtol=1e-5)
+
+
+def test_pipeline_rejects_encoder_models():
+    """The compiled pipeline must loudly reject post-norm/MLM encoders and
+    per-layer local-attention patterns instead of training wrong numerics."""
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.runtime.pipe.engine import check_pipeline_model_support
+    bert = build_model("bert-base", num_layers=2, hidden_size=32, num_heads=4,
+                       intermediate_size=64, vocab_size=128)
+    with pytest.raises(NotImplementedError):
+        check_pipeline_model_support(bert.cfg)
+    from deepspeed_tpu.models.config import TransformerConfig
+    neo_like = TransformerConfig(sliding_window=8, local_attention_every=2)
+    with pytest.raises(NotImplementedError):
+        check_pipeline_model_support(neo_like)
