@@ -87,6 +87,10 @@ class InferenceEngine:
         input_ids: (B, S_prompt) — right-aligned prompts (no padding support
         in v1; use the ragged v2 engine for mixed lengths).
         """
+        if not self.model.cfg.causal or self.model.cfg.mlm_head:
+            raise NotImplementedError(
+                "generate() is autoregressive; BERT-style encoders are "
+                "served with forward() (fill-mask / embedding workloads)")
         ids = jnp.asarray(np.asarray(input_ids), jnp.int32)
         b, s_prompt = ids.shape
         max_len = s_prompt + max_new_tokens

@@ -452,6 +452,159 @@ class GPTJContainer(LayerContainer):
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
 
 
+def _t_rms_offset(w, cfg):
+    """Gemma stores RMSNorm weights as offsets (applied as x*(1+w)); adding
+    1 at load maps them onto the standard x*w RMSNorm."""
+    # fp32 add: HF computes 1 + w.float() per call; adding in a bf16
+    # checkpoint's dtype would round the offset at load
+    return w.astype(np.float32) + 1.0
+
+
+class GemmaContainer(LlamaContainer):
+    """Gemma (1): GeGLU MLP, sqrt(E)-scaled embeddings, offset RMSNorm
+    weights, explicit head_dim, tied head."""
+
+    layer_mapping = {
+        **LlamaContainer.layer_mapping,
+        "norm1.scale": Param("model.layers.{l}.input_layernorm.weight", _t_rms_offset),
+        "norm2.scale": Param("model.layers.{l}.post_attention_layernorm.weight",
+                             _t_rms_offset),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("model.embed_tokens.weight"),
+        "final_norm.scale": Param("model.norm.weight", _t_rms_offset),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        if getattr(hf_cfg, "query_pre_attn_scalar", None) is not None:
+            raise NotImplementedError(
+                "gemma2 (pre+post norms, logit softcapping) not mapped")
+        return _llama_family_config(
+            hf_cfg, activation="geglu",
+            head_dim=_get(hf_cfg, "head_dim"),
+            embed_scale=float(hf_cfg.hidden_size) ** 0.5,
+            tie_embeddings=True)
+
+
+def _t_mpt_qkv(idx):
+    """MPT fused Wqkv is stacked [q; k; v], each (E, E)."""
+
+    def t(w, cfg):
+        e = cfg.hidden_size
+        part = w[idx * e:(idx + 1) * e]                # (E, E)
+        return part.T.reshape(e, cfg.num_heads, cfg.dims_per_head)
+
+    return t
+
+
+class MptContainer(LayerContainer):
+    """MPT (MosaicML): ALiBi positions, bias-free stacked-QKV blocks,
+    layernorms without biases, exact gelu, tied head."""
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.blocks.{l}.attn.Wqkv.weight", _t_mpt_qkv(0)),
+        "attn.wk": Param("transformer.blocks.{l}.attn.Wqkv.weight", _t_mpt_qkv(1)),
+        "attn.wv": Param("transformer.blocks.{l}.attn.Wqkv.weight", _t_mpt_qkv(2)),
+        "attn.wo": Param("transformer.blocks.{l}.attn.out_proj.weight", t_o_heads),
+        "norm1.scale": Param("transformer.blocks.{l}.norm_1.weight"),
+        "norm1.bias": Param("transformer.blocks.{l}.norm_1.bias", optional=True),
+        "norm2.scale": Param("transformer.blocks.{l}.norm_2.weight"),
+        "norm2.bias": Param("transformer.blocks.{l}.norm_2.bias", optional=True),
+        "mlp.wi": Param("transformer.blocks.{l}.ffn.up_proj.weight", t_linear),
+        "mlp.wo": Param("transformer.blocks.{l}.ffn.down_proj.weight", t_linear),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("transformer.wte.weight"),
+        "final_norm.scale": Param("transformer.norm_f.weight"),
+        "final_norm.bias": Param("transformer.norm_f.bias", optional=True),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        attn_cfg = getattr(hf_cfg, "attn_config", None)
+        if attn_cfg is not None and not getattr(attn_cfg, "alibi", True):
+            raise NotImplementedError("MPT without ALiBi (rope variants) not mapped")
+        if attn_cfg is not None and getattr(attn_cfg, "qk_ln", False):
+            raise NotImplementedError("MPT qk_ln variant not mapped")
+        if not getattr(hf_cfg, "no_bias", True):
+            raise NotImplementedError(
+                "MPT no_bias=False checkpoints (biased Wqkv/out_proj/ffn) "
+                "not mapped — loading would silently drop the biases")
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.d_model,
+            num_layers=hf_cfg.n_layers, num_heads=hf_cfg.n_heads,
+            intermediate_size=int(hf_cfg.expansion_ratio * hf_cfg.d_model),
+            max_seq_len=_get(hf_cfg, "max_seq_len", default=2048),
+            activation="gelu_exact", norm="layernorm", position="alibi",
+            use_bias=False, tie_embeddings=True,
+            norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+    @classmethod
+    def build_params(cls, sd, cfg):
+        params = super().build_params(sd, cfg)
+        # layernorm applies a bias unconditionally; MPT's no_bias checkpoints
+        # carry none — synthesize zeros
+        for nm in ("norm1", "norm2"):
+            grp = params["layers"][nm]
+            if "bias" not in grp:
+                grp["bias"] = np.zeros_like(grp["scale"])
+        if "bias" not in params["final_norm"]:
+            params["final_norm"]["bias"] = np.zeros_like(params["final_norm"]["scale"])
+        return params
+
+
+class StableLmContainer(LayerContainer):
+    """StableLM: layernorm (with biases) around a Llama-style block, partial
+    rotary, optional qkv biases, untied head."""
+
+    layer_mapping = {
+        "attn.wq": Param("model.layers.{l}.self_attn.q_proj.weight", t_q_heads),
+        "attn.wk": Param("model.layers.{l}.self_attn.k_proj.weight", t_kv_heads),
+        "attn.wv": Param("model.layers.{l}.self_attn.v_proj.weight", t_kv_heads),
+        "attn.bq": Param("model.layers.{l}.self_attn.q_proj.bias", t_q_bias,
+                         optional=True),
+        "attn.bk": Param("model.layers.{l}.self_attn.k_proj.bias", t_kv_bias,
+                         optional=True),
+        "attn.bv": Param("model.layers.{l}.self_attn.v_proj.bias", t_kv_bias,
+                         optional=True),
+        "attn.wo": Param("model.layers.{l}.self_attn.o_proj.weight", t_o_heads),
+        "norm1.scale": Param("model.layers.{l}.input_layernorm.weight"),
+        "norm1.bias": Param("model.layers.{l}.input_layernorm.bias"),
+        "norm2.scale": Param("model.layers.{l}.post_attention_layernorm.weight"),
+        "norm2.bias": Param("model.layers.{l}.post_attention_layernorm.bias"),
+        "mlp.wi_gate": Param("model.layers.{l}.mlp.gate_proj.weight", t_linear),
+        "mlp.wi_up": Param("model.layers.{l}.mlp.up_proj.weight", t_linear),
+        "mlp.wo": Param("model.layers.{l}.mlp.down_proj.weight", t_linear),
+    }
+    non_layer_mapping = {
+        "embed.tok": Param("model.embed_tokens.weight"),
+        "embed.lm_head": Param("lm_head.weight", t_linear),
+        "final_norm.scale": Param("model.norm.weight"),
+        "final_norm.bias": Param("model.norm.bias"),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        if getattr(hf_cfg, "use_parallel_residual", False):
+            raise NotImplementedError("stablelm parallel-residual variant not mapped")
+        if getattr(hf_cfg, "qk_layernorm", False):
+            raise NotImplementedError("stablelm qk_layernorm variant not mapped")
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            num_kv_heads=_get(hf_cfg, "num_key_value_heads"),
+            intermediate_size=hf_cfg.intermediate_size,
+            max_seq_len=hf_cfg.max_position_embeddings,
+            activation="swiglu", norm="layernorm", position="rope",
+            rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
+            rotary_pct=float(_get(hf_cfg, "partial_rotary_factor", default=0.25)),
+            qkv_bias=bool(_get(hf_cfg, "use_qkv_bias", default=False)),
+            tie_embeddings=False,
+            norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-5)))
+
+
 class BertContainer(LayerContainer):
     """BERT (reference ``module_inject/containers/bert.py``): post-norm
     encoder blocks, token-type embeddings, embedding layernorm, MLM head
@@ -728,6 +881,9 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "distilbert": DistilBertContainer,
     "bert": BertContainer,
     "bloom": BloomContainer,
+    "gemma": GemmaContainer,
+    "mpt": MptContainer,
+    "stablelm": StableLmContainer,
     "llama": LlamaContainer,
     "mistral": MistralContainer,
     "mixtral": MixtralContainer,
@@ -744,12 +900,40 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
 }
 
 
+class AutoContainer(LlamaContainer):
+    """Best-effort fallback for unmapped decoder architectures with the
+    Llama module layout — the analog of the reference's AutoTP
+    (``module_inject/auto_tp.py:189``), which shards unrecognized models by
+    pattern-matching their linear layers rather than per-arch policy."""
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return _llama_family_config(
+            hf_cfg, sliding_window=_get(hf_cfg, "sliding_window"))
+
+
+def _looks_llama_shaped(hf_cfg) -> bool:
+    return all(getattr(hf_cfg, f, None) is not None
+               for f in ("hidden_size", "num_hidden_layers",
+                         "num_attention_heads", "intermediate_size",
+                         "rms_norm_eps"))
+
+
 def resolve_container(hf_cfg) -> Type[LayerContainer]:
     arch = (getattr(hf_cfg, "architectures", None) or [type(hf_cfg).__name__])[0].lower()
-    # longest-match so "qwen2moe" wins over "qwen2"
+    # prefix-match (HF arch strings start with the model type), longest key
+    # first so "qwen2moe" wins over "qwen2"; substring matching would
+    # capture e.g. RoBERTa under "bert"
     for key in sorted(ARCH_CONTAINERS, key=len, reverse=True):
-        if key in arch.replace("_", ""):
+        if arch.replace("_", "").startswith(key):
             return ARCH_CONTAINERS[key]
+    if _looks_llama_shaped(hf_cfg):
+        from ....utils.logging import logger
+        logger.warning(
+            "no explicit container for architecture %r; attempting the "
+            "AutoContainer Llama-layout fallback (reference AutoTP analog). "
+            "Verify output parity before trusting it.", arch)
+        return AutoContainer
     raise NotImplementedError(
         f"no v2 model implementation for architecture {arch!r}; "
         f"known: {sorted(ARCH_CONTAINERS)}")

@@ -34,6 +34,11 @@ def _use_pallas_paged() -> bool:
 
 class PagedModelRunner:
     def __init__(self, model: CausalLM, block_size: int, max_blocks_per_seq: int):
+        if model.cfg.post_norm or model.cfg.mlm_head or not model.cfg.causal:
+            raise NotImplementedError(
+                "the paged serving runner executes causal pre-norm decoder "
+                "blocks; BERT-style encoders are not autoregressive — serve "
+                "them with InferenceEngine (v1) forward passes")
         self.model = model
         self.cfg = model.cfg
         self.block_size = block_size
@@ -60,6 +65,8 @@ class PagedModelRunner:
         dt = cfg.act_dtype
         b, c = ids.shape
         h = params["embed"]["tok"].astype(dt)[ids]
+        if cfg.embed_scale != 1.0:
+            h = h * jnp.asarray(cfg.embed_scale, dt)
         if cfg.position == "learned":
             h = h + params["embed"]["pos"].astype(dt)[
                 jnp.clip(positions + cfg.position_offset, 0,

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 
 from . import layers as L
 from .config import TransformerConfig
-from .transformer import CausalLM, _axes_of
+from .transformer import (CausalLM, _axes_of, lm_head_logits,
+                          logit_buffer_bytes, masked_token_nll)
 
 
 def init_mlm_head(rng, cfg: TransformerConfig):
@@ -82,12 +83,9 @@ class EncoderLM(CausalLM):
                                     token_type_ids=token_type_ids)
         h = self._transform(params, h)
         w, transpose = self._lm_head_weight(params)
-        if transpose:
-            logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
-        else:
-            logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
-        if cfg.mlm_head and "mlm" in params:
-            logits = logits + params["mlm"]["decoder_bias"].astype(logits.dtype)
+        bias = (params["mlm"]["decoder_bias"]
+                if cfg.mlm_head and "mlm" in params else None)
+        logits = lm_head_logits(h, w, transpose, dt, bias)
         if return_aux_loss:
             return logits, aux
         return logits
@@ -110,9 +108,9 @@ class EncoderLM(CausalLM):
         bias = None
         if cfg.mlm_head and "mlm" in head_params:
             bias = head_params["mlm"]["decoder_bias"]
-        logit_bytes = labels.size * cfg.vocab_size * 4
         if (cfg.loss_chunks > 0 and cfg.vocab_size >= 4096
-                and logit_bytes > cfg.loss_chunk_threshold_bytes):
+                and logit_buffer_bytes(labels.size, cfg)
+                > cfg.loss_chunk_threshold_bytes):
             from ..ops.cross_entropy import lm_cross_entropy
             if bias is not None:
                 # fold the vocab bias into the matmul: logits = [h, 1] @ [W, b]^T
@@ -121,15 +119,8 @@ class EncoderLM(CausalLM):
                 wv = jnp.concatenate([wv, bias[:, None].astype(wv.dtype)], axis=-1)
             return lm_cross_entropy(h, wv.astype(h.dtype), safe_labels,
                                     loss_mask=mask, n_chunks=cfg.loss_chunks)
-        dt = cfg.act_dtype
-        logits = jnp.einsum("bse,ve->bsv", h, wv.astype(dt))
-        if bias is not None:
-            logits = logits + bias.astype(logits.dtype)
-        logits = logits.astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        label_logits = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
-        nll = lse - label_logits
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        logits = lm_head_logits(h, wv, False, cfg.act_dtype, bias)
+        return masked_token_nll(logits, safe_labels, mask)
 
     def loss(self, params, batch):
         """Masked-LM cross-entropy over positions where labels != -100."""

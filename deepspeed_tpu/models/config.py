@@ -24,7 +24,8 @@ class TransformerConfig:
     head_dim: Optional[int] = None      # None → hidden_size // num_heads
     intermediate_size: Optional[int] = None  # None → 4x (gelu) / 8/3x rounded (swiglu)
     max_seq_len: int = 4096
-    activation: str = "swiglu"          # "swiglu" | "gelu" | "gelu_exact" | "relu"
+    # "swiglu"/"geglu" are gated (silu / tanh-gelu gate); rest are plain MLPs
+    activation: str = "swiglu"          # "swiglu" | "geglu" | "gelu" | "gelu_exact" | "relu"
     norm: str = "rmsnorm"               # "rmsnorm" | "layernorm"
     position: str = "rope"              # "rope" | "learned" | "alibi"
     position_offset: int = 0            # learned-position index offset (OPT: 2)
@@ -34,6 +35,7 @@ class TransformerConfig:
     parallel_block: bool = False        # h + attn(ln1 h) + mlp(ln2 h) (NeoX/Falcon)
     norm_eps: float = 1e-5
     embedding_norm: bool = False        # layernorm right after token embed (BLOOM/BERT)
+    embed_scale: float = 1.0            # token-embedding multiplier (Gemma: sqrt(E))
     post_norm: bool = False             # norm AFTER residual add (BERT) vs pre-LN
     type_vocab_size: int = 0            # token-type (segment) embeddings (BERT)
     mlm_head: bool = False              # BERT MLM head: dense+gelu+LN+decoder bias
@@ -85,7 +87,7 @@ class TransformerConfig:
     def ffn_size(self) -> int:
         if self.intermediate_size is not None:
             return self.intermediate_size
-        if self.activation == "swiglu":
+        if self.activation in ("swiglu", "geglu"):
             return ((int(self.hidden_size * 8 / 3) + 255) // 256) * 256
         return 4 * self.hidden_size
 

@@ -225,7 +225,7 @@ def init_mlp(rng, cfg: TransformerConfig):
     e, f = cfg.hidden_size, cfg.ffn_size
     r = jax.random.split(rng, 3)
     std = 0.02
-    if cfg.activation == "swiglu":
+    if cfg.activation in ("swiglu", "geglu"):
         params = {
             "wi_gate": _normal(r[0], (e, f), cfg.p_dtype, std),
             "wi_up": _normal(r[1], (e, f), cfg.p_dtype, std),
@@ -248,10 +248,12 @@ def init_mlp(rng, cfg: TransformerConfig):
 def apply_mlp(params, x, cfg: TransformerConfig):
     dt = cfg.act_dtype
     mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
-    if cfg.activation == "swiglu":
+    if cfg.activation in ("swiglu", "geglu"):
         g = jnp.einsum("bse,ef->bsf", x, params["wi_gate"].astype(dt))
         u = jnp.einsum("bse,ef->bsf", x, params["wi_up"].astype(dt))
-        h = jax.nn.silu(g) * u
+        gate = (jax.nn.gelu(g, approximate=True) if cfg.activation == "geglu"
+                else jax.nn.silu(g))
+        h = gate * u
     else:
         h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
         if mlp_bias:

@@ -83,6 +83,33 @@ def _remat_policy(name: str):
     return None
 
 
+def lm_head_logits(h, w, transpose, dt, bias=None):
+    """logits = h @ (w if transpose else w.T) (+ bias): (B, S, E) → (B, S, V)."""
+    eq = "bse,ev->bsv" if transpose else "bse,ve->bsv"
+    logits = jnp.einsum(eq, h, w.astype(dt))
+    if bias is not None:
+        logits = logits + bias.astype(logits.dtype)
+    return logits
+
+
+def masked_token_nll(logits, labels, loss_mask=None):
+    """Mean fp32 cross-entropy over (B, S) tokens; loss_mask weights (or
+    drops) positions. Avoids materializing a full fp32 log-softmax."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    nll = lse - label_logits
+    if loss_mask is None:
+        return jnp.mean(nll)
+    return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+
+
+def logit_buffer_bytes(n_tokens, cfg):
+    """Size of the (B, S, V) logits the dense loss would materialize —
+    the chunked-CE engagement test shared by decoder and encoder heads."""
+    return n_tokens * cfg.vocab_size * (2 if cfg.act_dtype != jnp.float32 else 4)
+
+
 class CausalLM:
     """Decoder-only LM covering GPT-2 / Llama / Mixtral families."""
 
@@ -184,6 +211,8 @@ class CausalLM:
         cfg = self.cfg
         dt = cfg.act_dtype
         h = embed_params["tok"].astype(dt)[input_ids]
+        if cfg.embed_scale != 1.0:   # Gemma: sqrt(E), cast like HF's normalizer
+            h = h * jnp.asarray(cfg.embed_scale, dt)
         if cfg.position == "learned":
             if positions is None:
                 positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
@@ -207,25 +236,13 @@ class CausalLM:
         if "final_norm" in head_params:   # absent for post-norm encoders
             h = L.apply_norm(head_params["final_norm"], h, cfg)
         w, transpose = self._lm_head_weight(head_params)
-        logit_bytes = (labels.size * cfg.vocab_size
-                       * (2 if cfg.act_dtype != jnp.float32 else 4))
         if (cfg.loss_chunks > 0 and cfg.vocab_size >= 4096
-                and logit_bytes > cfg.loss_chunk_threshold_bytes):
+                and logit_buffer_bytes(labels.size, cfg) > cfg.loss_chunk_threshold_bytes):
             from ..ops.cross_entropy import lm_cross_entropy
             return lm_cross_entropy(h, w.astype(h.dtype), labels, loss_mask=loss_mask,
                                     n_chunks=cfg.loss_chunks, transpose_w=transpose)
-        dt = cfg.act_dtype
-        if transpose:
-            logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
-        else:
-            logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
-        logits = logits.astype(jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        nll = lse - label_logits
-        if loss_mask is None:
-            return jnp.mean(nll)
-        return jnp.sum(nll * loss_mask) / jnp.maximum(jnp.sum(loss_mask), 1.0)
+        logits = lm_head_logits(h, w, transpose, cfg.act_dtype)
+        return masked_token_nll(logits, labels, loss_mask)
 
     def hidden_states(self, params, input_ids, *, positions=None, segment_ids=None,
                       token_type_ids=None):
@@ -358,10 +375,9 @@ class CausalLM:
         # The fused path trades one extra lm-head matmul (bwd recompute) for
         # never materializing (B, S, V): a win only once the logits are
         # actually big. Shapes are static under jit, so decide here.
-        logit_bytes = (batch["input_ids"].size * cfg.vocab_size
-                       * (2 if cfg.act_dtype != jnp.float32 else 4))
         if (cfg.loss_chunks > 0 and cfg.vocab_size >= 4096
-                and logit_bytes > cfg.loss_chunk_threshold_bytes):
+                and logit_buffer_bytes(batch["input_ids"].size, cfg)
+                > cfg.loss_chunk_threshold_bytes):
             # fused vocab-chunked path: the (B, S, V) logits never exist
             from ..ops.cross_entropy import lm_cross_entropy
             h, aux = self.hidden_states(params, batch["input_ids"],
@@ -375,17 +391,7 @@ class CausalLM:
                                      positions=batch.get("positions"),
                                      segment_ids=batch.get("segment_ids"),
                                      return_aux_loss=True)
-            logits = logits.astype(jnp.float32)
-            # nll = logsumexp(logits) - logits[label]: avoids materializing
-            # the full (B, S, V) log-softmax in fp32 (only the (B, S)
-            # reductions and the gathered label logits leave the fusion).
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            label_logits = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-            nll = lse - label_logits
-            if mask is None:
-                loss = jnp.mean(nll)
-            else:
-                loss = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            loss = masked_token_nll(logits, labels, mask)
         if cfg.is_moe:
             loss = loss + cfg.moe_aux_loss_coef * aux
         return loss

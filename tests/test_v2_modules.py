@@ -392,3 +392,52 @@ def test_pipeline_rejects_encoder_models():
     neo_like = TransformerConfig(sliding_window=8, local_attention_every=2)
     with pytest.raises(NotImplementedError):
         check_pipeline_model_support(neo_like)
+
+
+def test_container_gemma_geglu_scaled_embed():
+    """Gemma: sqrt(E)-scaled embeddings, offset RMSNorm (+1 at load), GeGLU
+    MLP, explicit head_dim, tied head."""
+    from transformers import GemmaConfig, GemmaForCausalLM
+    torch.manual_seed(0)
+    m = GemmaForCausalLM(GemmaConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        intermediate_size=64, max_position_embeddings=64))
+    _parity(m)
+
+
+def test_container_mpt_alibi_stacked_qkv():
+    """MPT: stacked (non-interleaved) fused Wqkv, ALiBi, bias-free norms."""
+    from transformers import MptConfig, MptForCausalLM
+    torch.manual_seed(0)
+    m = MptForCausalLM(MptConfig(
+        vocab_size=128, d_model=32, n_layers=2, n_heads=4,
+        expansion_ratio=2, max_seq_len=64))
+    _parity(m)
+
+
+def test_container_stablelm_partial_rotary_ln():
+    from transformers import StableLmConfig, StableLmForCausalLM
+    torch.manual_seed(0)
+    m = StableLmForCausalLM(StableLmConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=64, partial_rotary_factor=0.5))
+    _parity(m)
+
+
+def test_auto_container_fallback_unmapped_llama_like():
+    """An unmapped arch with the Llama module layout converts through the
+    AutoContainer fallback (reference AutoTP analog) with exact parity."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(0)
+    cfg = LlamaConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                      num_attention_heads=4, num_key_value_heads=2,
+                      intermediate_size=64, max_position_embeddings=64)
+    cfg.architectures = ["TotallyUnknownForCausalLM"]
+    from deepspeed_tpu.inference.v2.model_implementations.archs import (
+        AutoContainer, resolve_container)
+    assert resolve_container(cfg) is AutoContainer
+    m = LlamaForCausalLM(cfg)
+    m.config.architectures = ["TotallyUnknownForCausalLM"]
+    _parity(m)
