@@ -120,6 +120,49 @@ class Qwen2Container(LlamaContainer):
         return _llama_family_config(hf_cfg, qkv_bias=True)
 
 
+class Qwen2MoeContainer(Qwen2Container):
+    """Qwen2-MoE (reference ``model_implementations/qwen_v2_moe``): dense
+    Qwen2 attention (inherited q/k/v bias rows) + routed experts WITHOUT
+    top-k renormalization + an always-on shared expert behind a sigmoid
+    gate."""
+
+    layer_mapping = {
+        **{k: v for k, v in Qwen2Container.layer_mapping.items()
+           if not k.startswith("mlp.")},
+        "mlp.router": Param("model.layers.{l}.mlp.gate.weight", t_linear),
+        "mlp.wi_gate": Param(
+            "model.layers.{l}.mlp.experts.{x}.gate_proj.weight", t_linear),
+        "mlp.wi_up": Param(
+            "model.layers.{l}.mlp.experts.{x}.up_proj.weight", t_linear),
+        "mlp.wo": Param(
+            "model.layers.{l}.mlp.experts.{x}.down_proj.weight", t_linear),
+        "mlp.shared_wi_gate": Param(
+            "model.layers.{l}.mlp.shared_expert.gate_proj.weight", t_linear),
+        "mlp.shared_wi_up": Param(
+            "model.layers.{l}.mlp.shared_expert.up_proj.weight", t_linear),
+        "mlp.shared_wo": Param(
+            "model.layers.{l}.mlp.shared_expert.down_proj.weight", t_linear),
+        "mlp.shared_gate": Param(
+            "model.layers.{l}.mlp.shared_expert_gate.weight", t_linear),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        if getattr(hf_cfg, "mlp_only_layers", None) or \
+                int(_get(hf_cfg, "decoder_sparse_step", default=1)) != 1:
+            raise NotImplementedError(
+                "qwen2-moe with interleaved dense-MLP layers "
+                "(mlp_only_layers/decoder_sparse_step) is not scan-homogeneous")
+        return _llama_family_config(
+            hf_cfg, qkv_bias=True,
+            intermediate_size=int(hf_cfg.moe_intermediate_size),
+            num_experts=int(_get(hf_cfg, "num_experts", default=8)),
+            num_experts_per_tok=int(_get(hf_cfg, "num_experts_per_tok", default=2)),
+            moe_norm_topk=bool(_get(hf_cfg, "norm_topk_prob", default=False)),
+            moe_shared_expert_size=int(
+                _get(hf_cfg, "shared_expert_intermediate_size", default=0)))
+
+
 def _t_phi3_q(w, cfg):
     q = w[: cfg.num_heads * cfg.dims_per_head]
     return q.T.reshape(cfg.hidden_size, cfg.num_heads, cfg.dims_per_head)
@@ -887,7 +930,7 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "llama": LlamaContainer,
     "mistral": MistralContainer,
     "mixtral": MixtralContainer,
-    "qwen2moe": MixtralContainer,   # qwen2-moe shares the expert layout
+    "qwen2moe": Qwen2MoeContainer,
     "qwen2": Qwen2Container,
     "phi3": Phi3Container,
     "phi": PhiContainer,
@@ -910,6 +953,32 @@ class AutoContainer(LlamaContainer):
     def config(cls, hf_cfg):
         return _llama_family_config(
             hf_cfg, sliding_window=_get(hf_cfg, "sliding_window"))
+
+    # non-parameter buffers it is safe to leave unread
+    _IGNORABLE = ("rotary_emb", "masked_bias", ".attn.bias", "inv_freq")
+
+    @classmethod
+    def build_params(cls, sd, cfg):
+        # A config can be Llama-shaped while the layout is not (e.g. extra
+        # q/k norms): any layer-0 tensor the mapping never reads means the
+        # fallback would silently drop load-bearing weights — refuse loudly
+        # instead (the explicit-container path's behavior for unknown archs).
+        consumed = set()
+        for param in cls.layer_mapping.values():
+            for src in param.srcs:
+                for x in range(max(1, cfg.num_experts)):
+                    consumed.add(src.format(l=0, x=x))
+        for param in cls.non_layer_mapping.values():
+            consumed.update(param.srcs)
+        unread = [k for k in sd
+                  if (".0." in k or ".0.weight" in k) and "layers.0." in k
+                  and k not in consumed
+                  and not any(t in k for t in cls._IGNORABLE)]
+        if unread:
+            raise NotImplementedError(
+                "AutoContainer fallback refuses this checkpoint: layer-0 "
+                f"tensors outside the Llama layout would be dropped: {unread}")
+        return super().build_params(sd, cfg)
 
 
 def _looks_llama_shaped(hf_cfg) -> bool:

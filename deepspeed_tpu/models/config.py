@@ -57,6 +57,8 @@ class TransformerConfig:
     num_experts_per_tok: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_loss_coef: float = 0.01
+    moe_norm_topk: bool = True          # renormalize top-k gates (Mixtral yes, Qwen2-MoE no)
+    moe_shared_expert_size: int = 0     # always-on shared expert width (Qwen2-MoE)
     # "einsum": capacity-bounded one-hot dispatch (GShard/EP all-to-all);
     # "grouped": dropless sort-by-expert + ragged_dot (megablox pattern,
     # expert axis unsharded only)

@@ -271,9 +271,10 @@ def apply_mlp(params, x, cfg: TransformerConfig):
 # ---- MoE MLP ------------------------------------------------------------
 
 def init_moe_mlp(rng, cfg: TransformerConfig):
-    """Mixtral-style top-k routed experts with swiglu experts."""
+    """Mixtral-style top-k routed experts with swiglu experts (+ optional
+    Qwen2-MoE always-on shared expert with its own sigmoid gate)."""
     e, f, x = cfg.hidden_size, cfg.ffn_size, cfg.num_experts
-    r = jax.random.split(rng, 4)
+    r = jax.random.split(rng, 8)
     std = 0.02
     params = {
         "router": _normal(r[0], (e, x), cfg.p_dtype, std),
@@ -287,7 +288,29 @@ def init_moe_mlp(rng, cfg: TransformerConfig):
         "wi_up": ("expert", "embed", "mlp"),
         "wo": ("expert", "mlp", "embed"),
     }
+    if cfg.moe_shared_expert_size:
+        s = cfg.moe_shared_expert_size
+        params.update(
+            shared_wi_gate=_normal(r[4], (e, s), cfg.p_dtype, std),
+            shared_wi_up=_normal(r[5], (e, s), cfg.p_dtype, std),
+            shared_wo=_normal(r[6], (s, e), cfg.p_dtype,
+                              std / math.sqrt(2 * cfg.num_layers)),
+            shared_gate=_normal(r[7], (e, 1), cfg.p_dtype, std))
+        axes.update(shared_wi_gate=("embed", "mlp"), shared_wi_up=("embed", "mlp"),
+                    shared_wo=("mlp", "embed"), shared_gate=("embed", "unmodeled"))
     return params, axes
+
+
+def _apply_shared_expert(params, x, cfg: TransformerConfig):
+    """Qwen2-MoE shared expert: swiglu MLP weighted by a sigmoid gate."""
+    dt = cfg.act_dtype
+    g = jnp.einsum("...e,ef->...f", x, params["shared_wi_gate"].astype(dt))
+    u = jnp.einsum("...e,ef->...f", x, params["shared_wi_up"].astype(dt))
+    sh = jnp.einsum("...f,fe->...e", jax.nn.silu(g) * u,
+                    params["shared_wo"].astype(dt))
+    gate = jax.nn.sigmoid(
+        jnp.einsum("...e,eo->...o", x, params["shared_gate"].astype(dt)))
+    return gate * sh
 
 
 def apply_moe_grouped(params, x, cfg: TransformerConfig):
@@ -309,7 +332,8 @@ def apply_moe_grouped(params, x, cfg: TransformerConfig):
 
     logits = jnp.einsum("te,ex->tx", tokens.astype(jnp.float32),
                         params["router"].astype(jnp.float32))
-    topk_idx, w, aux_loss = topk_gating_grouped(logits, k=k)
+    topk_idx, w, aux_loss = topk_gating_grouped(logits, k=k,
+                                                normalize=cfg.moe_norm_topk)
 
     expert_of_row = topk_idx.reshape(-1)                      # (T*k,)
     order = jnp.argsort(expert_of_row, stable=True)
@@ -323,6 +347,8 @@ def apply_moe_grouped(params, x, cfg: TransformerConfig):
                           params["wo"].astype(dt), group_sizes)
     w_sorted = jnp.take(w.reshape(-1), order, axis=0).astype(dt)
     out = jnp.zeros((t, e), dt).at[tok_of_sorted].add(rows * w_sorted[:, None])
+    if cfg.moe_shared_expert_size:
+        out = out + _apply_shared_expert(params, tokens.astype(dt), cfg)
     return out.reshape(b, s, e), aux_loss
 
 
@@ -372,7 +398,8 @@ def apply_moe_mlp(params, x, cfg: TransformerConfig):
     logits = jnp.einsum("te,ex->tx", tokens.astype(jnp.float32),
                         params["router"].astype(jnp.float32))
     combine, dispatch, aux_loss = topk_gating_einsum(
-        logits, k=cfg.num_experts_per_tok, capacity_factor=cfg.moe_capacity_factor)
+        logits, k=cfg.num_experts_per_tok, capacity_factor=cfg.moe_capacity_factor,
+        normalize=cfg.moe_norm_topk)
     # dispatch: (T, X, C) bool → expert inputs (X, C, E); the einsum against
     # batch-sharded tokens with expert-sharded output IS the all-to-all
     expert_in = constrain_exp(jnp.einsum("txc,te->xce", dispatch.astype(dt), tokens))
@@ -381,6 +408,8 @@ def apply_moe_mlp(params, x, cfg: TransformerConfig):
     h = jax.nn.silu(g) * u
     expert_out = constrain_exp(jnp.einsum("xcf,xfe->xce", h, params["wo"].astype(dt)))
     out = constrain_tok(jnp.einsum("txc,xce->te", combine.astype(dt), expert_out))
+    if cfg.moe_shared_expert_size:
+        out = out + _apply_shared_expert(params, tokens, cfg)
     return out.reshape(b, s, e), aux_loss
 
 

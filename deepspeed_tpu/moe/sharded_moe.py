@@ -40,10 +40,13 @@ def load_balancing_loss(gates, mask):
 
 
 def topk_gating_einsum(logits, k: int = 2, capacity_factor: float = 1.25,
-                       min_capacity: int = 4) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                       min_capacity: int = 4, normalize: bool = True
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Top-k gating producing einsum dispatch/combine tensors.
 
     logits: (T, X) raw router outputs (fp32).
+    ``normalize``: renormalize the k chosen gates to sum to 1 (Mixtral/top2
+    convention); False keeps raw softmax mass (Qwen2-MoE norm_topk_prob=False).
     Returns (combine (T, X, C) fp32, dispatch (T, X, C) bool, aux_loss scalar).
     """
     t, x = logits.shape
@@ -52,9 +55,11 @@ def topk_gating_einsum(logits, k: int = 2, capacity_factor: float = 1.25,
 
     # top-k expert choice per token
     topk_vals, topk_idx = jax.lax.top_k(gates, k)  # (T, k)
-    # normalize the k chosen gates (Mixtral/top2 convention)
-    denom = jnp.sum(topk_vals, axis=-1, keepdims=True)
-    topk_w = topk_vals / jnp.maximum(denom, 1e-9)
+    if normalize:
+        denom = jnp.sum(topk_vals, axis=-1, keepdims=True)
+        topk_w = topk_vals / jnp.maximum(denom, 1e-9)
+    else:
+        topk_w = topk_vals
 
     # full assignment mask for aux loss
     mask_tx = jnp.sum(jax.nn.one_hot(topk_idx, x, dtype=jnp.float32), axis=1)  # (T, X)
@@ -78,7 +83,7 @@ def topk_gating_einsum(logits, k: int = 2, capacity_factor: float = 1.25,
     return combine, dispatch, aux
 
 
-def topk_gating_grouped(logits, k: int = 2):
+def topk_gating_grouped(logits, k: int = 2, normalize: bool = True):
     """Top-k gating for the grouped (megablox-style) dropless path.
 
     Returns (topk_idx (T, k) int32, weights (T, k) fp32 normalized over the
@@ -89,8 +94,11 @@ def topk_gating_grouped(logits, k: int = 2):
     x = logits.shape[1]
     gates = jax.nn.softmax(logits, axis=-1)
     topk_vals, topk_idx = jax.lax.top_k(gates, k)
-    denom = jnp.sum(topk_vals, axis=-1, keepdims=True)
-    w = topk_vals / jnp.maximum(denom, 1e-9)
+    if normalize:
+        denom = jnp.sum(topk_vals, axis=-1, keepdims=True)
+        w = topk_vals / jnp.maximum(denom, 1e-9)
+    else:
+        w = topk_vals
     mask_tx = jnp.sum(jax.nn.one_hot(topk_idx, x, dtype=jnp.float32), axis=1)
     aux = load_balancing_loss(gates, mask_tx)
     return topk_idx.astype(jnp.int32), w.astype(jnp.float32), aux

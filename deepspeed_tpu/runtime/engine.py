@@ -53,6 +53,23 @@ def _tree_zeros_like(t, dtype=None):
     return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype or x.dtype), t)
 
 
+def _twinflow_host_mask(leaves, ratio):
+    """Pick which param leaves carry host optimizer state under Twin-Flow
+    partial offload: largest-first greedy until >= ratio of total elements
+    (reference ZeRO-Offload++ splits the flat partition at the same
+    fraction). Returns a bool list aligned with the flattened leaf order."""
+    sizes = [int(p.size) for p in leaves]
+    target = ratio * sum(sizes)
+    mask = [False] * len(leaves)
+    acc = 0
+    for i in sorted(range(len(leaves)), key=lambda i: -sizes[i]):
+        if acc >= target:
+            break
+        mask[i] = True
+        acc += sizes[i]
+    return mask
+
+
 def _global_norm(tree):
     leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
     return jnp.sqrt(sum(leaves))
@@ -174,17 +191,47 @@ class DeepSpeedEngine:
         self.optimizer = self._configure_optimizer(optimizer)
         self.opt_state_shardings = self._build_opt_state_shardings(abstract)
         self._host_optimizer = None
+        self._twinflow = None
         off_o = self._config.zero_config.offload_optimizer
         if off_o is not None and off_o.device == "cpu" and off_o.native:
             # ZeRO-Offload with the NATIVE host kernel: fp32 masters/moments
             # as host numpy, updated by csrc CPUAdam; only grads/params cross
             # the host-device boundary (reference stage_1_and_2.py:1189).
             from .zero.offload_host import HostOffloadOptimizer
-            self._host_optimizer = HostOffloadOptimizer(
-                self.optimizer.hyper, jax.device_get(self.module_params),
-                gradient_clipping=float(self._config.gradient_clipping or 0.0))
+            ratio = float(getattr(off_o, "ratio", 1.0))
+            host_tree = jax.device_get(self.module_params)
+            if ratio < 1.0:
+                # Twin-Flow (ZeRO-Offload++, blogs/deepspeed-offloadpp):
+                # only `ratio` of the optimizer state lives on host; the
+                # rest stays on the accelerator with a compiled update, so
+                # host-update latency shrinks proportionally.
+                flat, treedef = jax.tree.flatten(host_tree)
+                mask = _twinflow_host_mask(flat, ratio)
+                host_masked = treedef.unflatten(
+                    [p if m else None for p, m in zip(flat, mask)])
+                self._host_optimizer = HostOffloadOptimizer(
+                    self.optimizer.hyper, host_masked,
+                    gradient_clipping=float(self._config.gradient_clipping or 0.0))
+                dev_flat = jax.tree.leaves(self.module_params)
+                dev_masked = treedef.unflatten(
+                    [p if not m else None for p, m in zip(dev_flat, mask)])
+                with self.mesh:
+                    dev_state = jax.jit(self.optimizer.init)(dev_masked)
+                self._twinflow = {"mask": mask, "treedef": treedef,
+                                  "dev_state": dev_state}
+                host_elems = sum(p.size for p, m in zip(flat, mask) if m)
+                total = sum(p.size for p in flat)
+                log_dist(
+                    f"ZeRO-Offload++ Twin-Flow: ratio={ratio} → "
+                    f"{host_elems / total:.2%} of optimizer state on host, "
+                    "rest updated on device", ranks=[0])
+            else:
+                self._host_optimizer = HostOffloadOptimizer(
+                    self.optimizer.hyper, host_tree,
+                    gradient_clipping=float(self._config.gradient_clipping or 0.0))
+                log_dist("ZeRO-Offload: native host CPUAdam in the step loop",
+                         ranks=[0])
             self.opt_state = self._host_optimizer.state
-            log_dist("ZeRO-Offload: native host CPUAdam in the step loop", ranks=[0])
         else:
             with self.mesh:
                 self.opt_state = jax.jit(self.optimizer.init,
@@ -778,6 +825,13 @@ class DeepSpeedEngine:
             return loss_sum / gas, acc, gsq
 
         self._grad_accum_fn = grad_accum_fn
+        if self._twinflow is not None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def twinflow_dev_update(params_dev, opt_dev, grads_dev, lr, scale_inv):
+                g = jax.tree.map(lambda x: x * scale_inv, grads_dev)
+                return self.optimizer.apply(g, opt_dev, params_dev, lr=lr)
+
+            self._twinflow_update_fn = twinflow_dev_update
         self._train_step_fn = None
         self._grad_fn = None
         self._update_fn = None
@@ -793,8 +847,11 @@ class DeepSpeedEngine:
         scale_dev = self.scaler_state.scale
         loss, acc, gsq = self._grad_accum_fn(self.module_params, batch,
                                              scale_dev, gas=gas)
-        for x in jax.tree.leaves(acc):
-            x.copy_to_host_async()
+        tf = self._twinflow
+        mask = tf["mask"] if tf is not None else None
+        for i, x in enumerate(jax.tree.leaves(acc)):
+            if mask is None or mask[i]:   # only host-bound grads cross over
+                x.copy_to_host_async()
         gsq_f = float(gsq)
         scale = float(jax.device_get(scale_dev))
         divisor = scale * gas
@@ -803,12 +860,43 @@ class DeepSpeedEngine:
                                                     jnp.asarray(overflow))
         grad_norm = float("nan")
         if not overflow:
-            g_host = jax.tree.map(np.asarray, acc)
-            grad_norm = (gsq_f ** 0.5) / divisor
-            new_params = self._host_optimizer.step(
-                g_host, grad_divisor=divisor, lr=float(self._next_lr()),
-                grad_norm_sq=gsq_f / (divisor * divisor))
-            self.module_params = jax.device_put(new_params, self.param_shardings)
+            lr = float(self._next_lr())
+            unscaled_gsq = gsq_f / (divisor * divisor)
+            grad_norm = unscaled_gsq ** 0.5
+            if tf is None:
+                g_host = jax.tree.map(np.asarray, acc)
+                new_params = self._host_optimizer.step(
+                    g_host, grad_divisor=divisor, lr=lr,
+                    grad_norm_sq=unscaled_gsq)
+                self.module_params = jax.device_put(new_params, self.param_shardings)
+            else:
+                treedef = tf["treedef"]
+                flat_g = jax.tree.leaves(acc)
+                flat_p = jax.tree.leaves(self.module_params)
+                flat_sh = treedef.flatten_up_to(self.param_shardings)
+                host_g = treedef.unflatten(
+                    [np.asarray(g) if m else None for g, m in zip(flat_g, mask)])
+                # device half first — it runs async while CPUAdam works
+                scale_inv = 1.0 / divisor
+                clip = float(self._config.gradient_clipping or 0.0)
+                if clip > 0.0:   # same factor HostOffloadOptimizer derives
+                    scale_inv *= min(1.0, clip / (grad_norm + 1e-6))
+                dev_p = treedef.unflatten(
+                    [p if not m else None for p, m in zip(flat_p, mask)])
+                dev_g = treedef.unflatten(
+                    [g if not m else None for g, m in zip(flat_g, mask)])
+                new_dev_p, tf["dev_state"] = self._twinflow_update_fn(
+                    dev_p, tf["dev_state"], dev_g, jnp.float32(lr),
+                    jnp.float32(scale_inv))
+                new_host = self._host_optimizer.step(
+                    host_g, grad_divisor=divisor, lr=lr,
+                    grad_norm_sq=unscaled_gsq)
+                host_it = iter(jax.tree.leaves(new_host))
+                dev_it = iter(jax.tree.leaves(new_dev_p))
+                flat_new = [
+                    jax.device_put(next(host_it), sh) if m else next(dev_it)
+                    for m, sh in zip(mask, flat_sh)]
+                self.module_params = treedef.unflatten(flat_new)
         self._last_grad_norm = grad_norm
         self.micro_steps += gas
         self.global_steps += 1
@@ -1196,6 +1284,8 @@ class DeepSpeedEngine:
         state = {
             "module": self.module_params,
             "optimizer": self.opt_state,
+            **({"twinflow_device": self._twinflow["dev_state"]}
+               if self._twinflow is not None else {}),
             "scaler": self.scaler_state._asdict(),
             "meta": {
                 "global_steps": self.global_steps,
@@ -1244,6 +1334,8 @@ class DeepSpeedEngine:
             "optimizer": (self.opt_state,
                           None if self._host_optimizer is not None
                           else self.opt_state_shardings),
+            **({"twinflow_device": (self._twinflow["dev_state"], None)}
+               if self._twinflow is not None else {}),
             "scaler": (self.scaler_state._asdict(), None),
         }
         state = self._ckpt_engine().load(path, template)
@@ -1254,8 +1346,21 @@ class DeepSpeedEngine:
             if self._host_optimizer is not None:
                 self._host_optimizer.load_state_dict(state["optimizer"])
                 self.opt_state = self._host_optimizer.state
-                self.module_params = jax.device_put(self._host_optimizer.params(),
-                                                    self.param_shardings)
+                if self._twinflow is not None:
+                    self._twinflow["dev_state"] = state["twinflow_device"]
+                    # host masters overwrite only the host-owned leaves; the
+                    # device half came in with state["module"]
+                    tdef, mask = self._twinflow["treedef"], self._twinflow["mask"]
+                    flat_p = jax.tree.leaves(self.module_params)
+                    flat_sh = tdef.flatten_up_to(self.param_shardings)
+                    host_it = iter(jax.tree.leaves(self._host_optimizer.params()))
+                    flat_new = [
+                        jax.device_put(next(host_it), sh) if m else p
+                        for p, m, sh in zip(flat_p, mask, flat_sh)]
+                    self.module_params = tdef.unflatten(flat_new)
+                else:
+                    self.module_params = jax.device_put(
+                        self._host_optimizer.params(), self.param_shardings)
             else:
                 self.opt_state = state["optimizer"]
         self.scaler_state = LossScaleState(**{

@@ -360,10 +360,8 @@ def build_pipeline_loss(model, num_stages: int):
         m, mb, s = ids.shape
         dt = cfg.act_dtype
         flat_ids = ids.reshape(m * mb, s)
-        h = params["embed"]["tok"].astype(dt)[flat_ids]
-        if cfg.position == "learned":
-            pos = jnp.broadcast_to(jnp.arange(s) + cfg.position_offset, (m * mb, s))
-            h = h + params["embed"]["pos"].astype(dt)[pos]
+        # the model's own embed path (scale/type/norm variants included)
+        h = model.embed_fwd(params["embed"], flat_ids)
         h = h.reshape(m, mb, s, cfg.hidden_size)
 
         h = pipe_run(params["layers"], h)

@@ -441,3 +441,39 @@ def test_auto_container_fallback_unmapped_llama_like():
     m = LlamaForCausalLM(cfg)
     m.config.architectures = ["TotallyUnknownForCausalLM"]
     _parity(m)
+
+
+def test_container_qwen2_moe_shared_expert():
+    """Qwen2-MoE: un-renormalized top-k routing plus the sigmoid-gated
+    always-on shared expert; logits parity vs HF."""
+    from transformers import Qwen2MoeConfig, Qwen2MoeForCausalLM
+    torch.manual_seed(0)
+    m = Qwen2MoeForCausalLM(Qwen2MoeConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=80,
+        num_experts=4, num_experts_per_tok=2, max_position_embeddings=64,
+        decoder_sparse_step=1, mlp_only_layers=[]))
+    with torch.no_grad():
+        for layer in m.model.layers:
+            layer.self_attn.q_proj.bias.normal_()
+            layer.self_attn.k_proj.bias.normal_()
+            layer.self_attn.v_proj.bias.normal_()
+    _parity(m, tol=1e-2)
+
+
+def test_auto_container_refuses_non_llama_layout():
+    """AutoContainer must refuse checkpoints whose layer layout carries
+    tensors outside the Llama mapping (silently dropping them would corrupt
+    outputs)."""
+    from deepspeed_tpu.inference.v2.model_implementations.archs import AutoContainer
+    from transformers import LlamaConfig, LlamaForCausalLM
+    torch.manual_seed(0)
+    m = LlamaForCausalLM(LlamaConfig(
+        vocab_size=64, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, num_key_value_heads=2, intermediate_size=32))
+    sd = m.state_dict()
+    sd["model.layers.0.self_attn.q_norm.weight"] = torch.ones(8)
+    cfg = AutoContainer.config(m.config)
+    with pytest.raises(NotImplementedError, match="q_norm"):
+        AutoContainer.build_params(sd, cfg)
