@@ -264,5 +264,12 @@ class InferenceEngineV2:
 
 def build_hf_engine(model_or_path, engine_config: Optional[RaggedInferenceEngineConfig] = None,
                     **kwargs) -> InferenceEngineV2:
-    """Analog of ``engine_factory.py:69``: build from an HF model instance."""
+    """Analog of ``engine_factory.py:69``: build from an HF model instance or
+    a checkpoint DIRECTORY (HF layout: config.json + [sharded] weights) —
+    the directory path never materializes a torch module."""
+    import os
+    if isinstance(model_or_path, str) and os.path.isdir(model_or_path):
+        from ...module_inject import native_from_checkpoint
+        model, params = native_from_checkpoint(model_or_path)
+        return InferenceEngineV2(model, engine_config, params=params, **kwargs)
     return InferenceEngineV2(model_or_path, engine_config, **kwargs)

@@ -1,13 +1,34 @@
 """Module injection: user-model → native-model conversion (AutoTP analog)."""
 
+from .load_checkpoint import (CheckpointStateDict,  # noqa: F401
+                              load_checkpoint_state_dict,
+                              native_from_checkpoint)
 from .replace_module import (hf_config_to_native, hf_to_native,  # noqa: F401
                              replace_transformer_layer)
 
 
 def as_inference_model(model, config=None):
-    """Normalize init_inference input → (CausalLM, params-or-None)."""
+    """Normalize init_inference input → (model, params-or-None).
+
+    ``config.checkpoint`` (reference ``inference/engine.py:444``) loads
+    weights from sharded checkpoint FILES: ``model`` may then be None (the
+    checkpoint dir's config.json resolves the arch), an HF config, or an
+    HF module whose weights are ignored in favor of the files.
+    """
     from ..models.config import TransformerConfig
     from ..models.transformer import CausalLM, build_model
+
+    ckpt = getattr(config, "checkpoint", None)
+    if ckpt is not None:
+        if isinstance(model, (CausalLM, TransformerConfig, str)):
+            raise TypeError(
+                "init_inference(checkpoint=...) maps HF-named tensors; pass "
+                "model=None (checkpoint dir with config.json), an HF config, "
+                "or an HF module — not a native model/preset")
+        hf_config = getattr(model, "config", model)   # module → its config
+        if hf_config is not None and not hasattr(hf_config, "architectures"):
+            hf_config = None
+        return native_from_checkpoint(ckpt, hf_config=hf_config)
 
     if isinstance(model, CausalLM):
         return model, None

@@ -1,0 +1,181 @@
+"""Checkpoint-file loading for inference: serve without a live torch model.
+
+Analog of ``deepspeed/module_inject/load_checkpoint.py`` +
+``inference/engine.py:444`` (sharded-checkpoint loading): the reference
+accepts ``init_inference(checkpoint=...)`` pointing at sharded weight files
+(or a JSON manifest listing them) so multi-hundred-GB models never need a
+fully materialized torch module. Here the same surface is a **lazy mapping**
+over HF-layout checkpoint directories — ``model.safetensors`` (single or
+index-sharded) or ``pytorch_model.bin`` (single or index-sharded) — that the
+declarative containers (``inference/v2/model_implementations/archs.py``)
+consume tensor-by-tensor: peak host memory is one shard (torch) or one
+tensor (safetensors), not the model.
+"""
+
+import json
+import os
+from collections import OrderedDict
+from collections.abc import Mapping
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..utils.logging import logger
+
+
+class CheckpointStateDict(Mapping):
+    """Lazy name→tensor mapping over sharded checkpoint files.
+
+    safetensors shards are read tensor-at-a-time (zero-copy slices); torch
+    ``.bin``/``.pt`` shards are deserialized whole and held in a 2-shard LRU
+    — containers walk layers in order and HF shards are name-contiguous, so
+    two slots absorb boundary straddles while peak host memory stays at two
+    shards, not the model (the point of serving from files). bf16 tensors
+    are upcast to fp32 on the way out (numpy has no bf16; the container
+    casts to the serving dtype anyway).
+    """
+
+    _LRU_SHARDS = 2
+
+    def __init__(self, weight_map: Dict[str, str]):
+        # weight_map: tensor name → absolute file path
+        self._map = dict(weight_map)
+        self._torch_cache: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    @classmethod
+    def from_files(cls, paths: List[str]) -> "CheckpointStateDict":
+        """Build the name→file map by enumerating each file ONCE (torch
+        shards loaded for enumeration stay in the LRU for the first reads)."""
+        sd = cls({})
+        for p in paths:
+            for name in sd._names_in(p):
+                sd._map[name] = p
+        return sd
+
+    def _load_shard(self, path):
+        if path in self._torch_cache:
+            self._torch_cache.move_to_end(path)
+        else:
+            import torch
+            self._torch_cache[path] = torch.load(
+                path, map_location="cpu", weights_only=True)
+            while len(self._torch_cache) > self._LRU_SHARDS:
+                self._torch_cache.popitem(last=False)
+        return self._torch_cache[path]
+
+    def _names_in(self, path) -> List[str]:
+        if path.endswith(".safetensors"):
+            from safetensors import safe_open
+            with safe_open(path, framework="pt") as f:
+                return list(f.keys())
+        return list(self._load_shard(path).keys())
+
+    # -- Mapping interface (what Param.materialize/build_params need) --
+
+    def __contains__(self, name):
+        return name in self._map
+
+    def __iter__(self):
+        return iter(self._map)
+
+    def __len__(self):
+        return len(self._map)
+
+    def __getitem__(self, name):
+        path = self._map[name]
+        if path.endswith(".safetensors"):
+            from safetensors import safe_open
+            with safe_open(path, framework="pt") as f:
+                t = f.get_tensor(name)
+        else:
+            t = self._load_shard(path)[name]
+        import torch
+        if t.dtype == torch.bfloat16:   # numpy cannot represent bf16
+            t = t.to(torch.float32)
+        return t
+
+
+_INDEX_FILES = ("model.safetensors.index.json", "pytorch_model.bin.index.json")
+_SINGLE_FILES = ("model.safetensors", "pytorch_model.bin")
+
+
+def load_checkpoint_state_dict(checkpoint) -> Tuple[CheckpointStateDict, Optional[str]]:
+    """Resolve a checkpoint spec → (lazy state dict, directory or None).
+
+    Accepted forms (reference ``inference/engine.py:444``):
+    - a directory in HF layout (index-sharded or single-file);
+    - a single weight file path;
+    - a JSON manifest path or dict with a ``checkpoints`` file list
+      (paths relative to the manifest's directory, or absolute).
+    """
+    base: Optional[str] = None
+    if isinstance(checkpoint, str) and os.path.isdir(checkpoint):
+        base = checkpoint
+        for idx in _INDEX_FILES:
+            p = os.path.join(base, idx)
+            if os.path.exists(p):
+                with open(p) as f:
+                    wm = json.load(f)["weight_map"]
+                return CheckpointStateDict(
+                    {k: os.path.join(base, v) for k, v in wm.items()}), base
+        for single in _SINGLE_FILES:
+            p = os.path.join(base, single)
+            if os.path.exists(p):
+                return CheckpointStateDict.from_files([p]), base
+        raise FileNotFoundError(
+            f"no checkpoint weights found under {base!r} "
+            f"(looked for {_INDEX_FILES + _SINGLE_FILES})")
+
+    if isinstance(checkpoint, str) and checkpoint.endswith(".json"):
+        base = os.path.dirname(os.path.abspath(checkpoint))
+        with open(checkpoint) as f:
+            checkpoint = json.load(f)
+
+    if isinstance(checkpoint, dict):
+        files = checkpoint.get("checkpoints") or checkpoint.get("checkpoint_files")
+        if not files:
+            raise ValueError(
+                "checkpoint manifest must list files under 'checkpoints'")
+        if isinstance(files, str):
+            files = [files]
+        if base is None:   # raw dict: no manifest directory to anchor to
+            base = checkpoint.get("base_path")
+            if base is None and any(not os.path.isabs(f) for f in files):
+                raise ValueError(
+                    "manifest passed as a dict has no directory to resolve "
+                    "relative paths against; use absolute paths or add "
+                    "'base_path'")
+        paths = [f if os.path.isabs(f) else os.path.join(base, f)
+                 for f in files]
+        return CheckpointStateDict.from_files(paths), base
+
+    if isinstance(checkpoint, str) and os.path.isfile(checkpoint):
+        return CheckpointStateDict.from_files([checkpoint]), \
+            os.path.dirname(os.path.abspath(checkpoint))
+
+    raise TypeError(f"unsupported checkpoint spec: {checkpoint!r}")
+
+
+def native_from_checkpoint(checkpoint, hf_config=None, dtype: Optional[str] = None):
+    """checkpoint spec (+ optional HF config) → (native model, params).
+
+    When ``hf_config`` is None the checkpoint directory must carry a
+    ``config.json`` (HF layout) to resolve the architecture.
+    """
+    from ..inference.v2.model_implementations import resolve_container
+    sd, base = load_checkpoint_state_dict(checkpoint)
+    if hf_config is None:
+        cfg_path = os.path.join(base or ".", "config.json")
+        if not os.path.exists(cfg_path):
+            raise ValueError(
+                "checkpoint has no config.json; pass the HF config (or a "
+                "model instance) to init_inference alongside `checkpoint`")
+        from transformers import AutoConfig
+        hf_config = AutoConfig.from_pretrained(base)
+    container = resolve_container(hf_config)
+    cfg = container.config(hf_config)
+    if dtype:
+        cfg = cfg.replace(dtype=dtype)
+    params = container.build_params(sd, cfg)
+    model = container.model_class(cfg)
+    logger.info("Loaded %s from checkpoint files (%d tensors) without a "
+                "torch module", type(model).__name__, len(sd))
+    return model, params

@@ -153,3 +153,30 @@ def test_generate_compiled_loop_matches_stepwise():
 
     for a, b in zip(outs_loop, outs_step):
         np.testing.assert_array_equal(a, b)
+
+
+def test_build_hf_engine_from_checkpoint_dir(tmp_path):
+    """build_hf_engine(path) boots the ragged engine straight from an HF
+    checkpoint directory — no torch module instantiated."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+    import torch
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(LlamaConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        max_position_embeddings=64))
+    hf.eval()
+    hf.save_pretrained(str(tmp_path))
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (build_hf_engine,
+                                                      RaggedInferenceEngineConfig)
+    eng = build_hf_engine(str(tmp_path),
+                          RaggedInferenceEngineConfig(kv_block_size=16,
+                                                      dtype="float32"),
+                          max_seq_len=64)
+    prompt = np.random.default_rng(0).integers(0, 128, (1, 8))
+    out = eng.generate([prompt[0]], max_new_tokens=6)[0]
+    # parity vs the module-injected v1 engine
+    v1 = ds.init_inference(hf, dtype="float32")
+    ref = np.asarray(v1.generate(prompt, max_new_tokens=6))[0, 8:]
+    np.testing.assert_array_equal(ref, out)
