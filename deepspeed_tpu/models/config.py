@@ -145,6 +145,34 @@ PRESETS = {
                                    max_seq_len=2048, activation="gelu", norm="layernorm",
                                    position="alibi", embedding_norm=True, tie_embeddings=True,
                                    use_bias=True),
+    # Falcon-7B (multi-query attention, parallel block, one shared norm)
+    "falcon-7b": TransformerConfig(vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71,
+                                   num_kv_heads=1, intermediate_size=18176, max_seq_len=2048,
+                                   activation="gelu_exact", norm="layernorm", parallel_block=True,
+                                   tie_embeddings=True),
+    # GPT-J-6B (interleaved partial rotary, parallel block, MLP-only biases)
+    "gptj-6b": TransformerConfig(vocab_size=50400, hidden_size=4096, num_layers=28, num_heads=16,
+                                 intermediate_size=16384, max_seq_len=2048, activation="gelu",
+                                 norm="layernorm", rotary_pct=64 / 256, rope_interleaved=True,
+                                 parallel_block=True, mlp_bias=True),
+    # GPT-NeoX-20B / Pythia family (partial rotary, parallel residual)
+    "gpt-neox-20b": TransformerConfig(vocab_size=50432, hidden_size=6144, num_layers=44, num_heads=64,
+                                      intermediate_size=24576, max_seq_len=2048,
+                                      activation="gelu_exact", norm="layernorm", rotary_pct=0.25,
+                                      parallel_block=True, use_bias=True),
+    # MPT-7B (ALiBi, bias-free, exact gelu)
+    "mpt-7b": TransformerConfig(vocab_size=50368, hidden_size=4096, num_layers=32, num_heads=32,
+                                intermediate_size=16384, max_seq_len=2048, activation="gelu_exact",
+                                norm="layernorm", position="alibi", tie_embeddings=True),
+    # Gemma-7B (GeGLU, sqrt(E)-scaled embeddings, wide head_dim)
+    "gemma-7b": TransformerConfig(vocab_size=256000, hidden_size=3072, num_layers=28, num_heads=16,
+                                  head_dim=256, intermediate_size=24576, max_seq_len=8192,
+                                  activation="geglu", embed_scale=3072.0 ** 0.5,
+                                  tie_embeddings=True, norm_eps=1e-6),
+    # Qwen2-7B (GQA + qkv biases)
+    "qwen2-7b": TransformerConfig(vocab_size=152064, hidden_size=3584, num_layers=28, num_heads=28,
+                                  num_kv_heads=4, intermediate_size=18944, max_seq_len=32768,
+                                  rope_theta=1e6, qkv_bias=True, norm_eps=1e-6),
     # Phi-2 (parallel block sharing one layernorm, partial rotary, biases)
     "phi-2": TransformerConfig(vocab_size=51200, hidden_size=2560, num_layers=32, num_heads=32,
                                intermediate_size=10240, max_seq_len=2048, activation="gelu",

@@ -39,6 +39,7 @@ class CheckpointStateDict(Mapping):
         # weight_map: tensor name → absolute file path
         self._map = dict(weight_map)
         self._torch_cache: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+        self._st_cache: "OrderedDict[str, Any]" = OrderedDict()
 
     @classmethod
     def from_files(cls, paths: List[str]) -> "CheckpointStateDict":
@@ -61,11 +62,21 @@ class CheckpointStateDict(Mapping):
                 self._torch_cache.popitem(last=False)
         return self._torch_cache[path]
 
+    def _open_st(self, path):
+        """Cached safe_open handle: per-tensor reads without reparsing the
+        shard header on every access (same LRU policy as torch shards)."""
+        if path in self._st_cache:
+            self._st_cache.move_to_end(path)
+        else:
+            from safetensors import safe_open
+            self._st_cache[path] = safe_open(path, framework="pt")
+            while len(self._st_cache) > self._LRU_SHARDS:
+                self._st_cache.popitem(last=False)
+        return self._st_cache[path]
+
     def _names_in(self, path) -> List[str]:
         if path.endswith(".safetensors"):
-            from safetensors import safe_open
-            with safe_open(path, framework="pt") as f:
-                return list(f.keys())
+            return list(self._open_st(path).keys())
         return list(self._load_shard(path).keys())
 
     # -- Mapping interface (what Param.materialize/build_params need) --
@@ -82,9 +93,7 @@ class CheckpointStateDict(Mapping):
     def __getitem__(self, name):
         path = self._map[name]
         if path.endswith(".safetensors"):
-            from safetensors import safe_open
-            with safe_open(path, framework="pt") as f:
-                t = f.get_tensor(name)
+            t = self._open_st(path).get_tensor(name)
         else:
             t = self._load_shard(path)[name]
         import torch
@@ -136,13 +145,14 @@ def load_checkpoint_state_dict(checkpoint) -> Tuple[CheckpointStateDict, Optiona
                 "checkpoint manifest must list files under 'checkpoints'")
         if isinstance(files, str):
             files = [files]
-        if base is None:   # raw dict: no manifest directory to anchor to
-            base = checkpoint.get("base_path")
-            if base is None and any(not os.path.isabs(f) for f in files):
-                raise ValueError(
-                    "manifest passed as a dict has no directory to resolve "
-                    "relative paths against; use absolute paths or add "
-                    "'base_path'")
+        # an explicit base_path always wins (same semantics whether the
+        # manifest arrived as a file or a dict)
+        base = checkpoint.get("base_path", base)
+        if base is None and any(not os.path.isabs(f) for f in files):
+            raise ValueError(
+                "manifest passed as a dict has no directory to resolve "
+                "relative paths against; use absolute paths or add "
+                "'base_path'")
         paths = [f if os.path.isabs(f) else os.path.join(base, f)
                  for f in files]
         return CheckpointStateDict.from_files(paths), base
@@ -163,11 +173,14 @@ def native_from_checkpoint(checkpoint, hf_config=None, dtype: Optional[str] = No
     from ..inference.v2.model_implementations import resolve_container
     sd, base = load_checkpoint_state_dict(checkpoint)
     if hf_config is None:
-        cfg_path = os.path.join(base or ".", "config.json")
-        if not os.path.exists(cfg_path):
+        # never fall back to cwd: a raw-dict manifest has no anchor
+        # directory, and passing None to from_pretrained would be treated
+        # as the hub repo id "None" (network lookup + misleading error)
+        if base is None or not os.path.exists(os.path.join(base, "config.json")):
             raise ValueError(
-                "checkpoint has no config.json; pass the HF config (or a "
-                "model instance) to init_inference alongside `checkpoint`")
+                "checkpoint has no config.json next to its weights; pass "
+                "the HF config (or a model instance) to init_inference "
+                "alongside `checkpoint`")
         from transformers import AutoConfig
         hf_config = AutoConfig.from_pretrained(base)
     container = resolve_container(hf_config)

@@ -66,6 +66,17 @@ class PipelineModule:
             n = model.cfg.num_layers
         else:
             n = len(self.layer_specs)
+        if partition_method not in ("uniform", "parameters") \
+                and not partition_method.startswith("type:"):
+            raise ValueError(f"unknown partition_method {partition_method!r}; "
+                             "expected 'uniform', 'parameters' or 'type:<regex>'")
+        if partition_method.startswith("type:"):
+            raise NotImplementedError(
+                "type-regex partitioning applies to heterogeneous LayerSpec "
+                "stacks; the compiled pipeline runs the homogeneous "
+                "scan-over-layers model where every stage has equal layers")
+        # 'parameters' (balance by param count) coincides with 'uniform'
+        # here: the stacked-layer model makes every layer identical in size
         if num_stages > 0 and n % num_stages != 0:
             raise ValueError(f"{n} layers not divisible into {num_stages} stages "
                              f"(partition_method={partition_method!r})")

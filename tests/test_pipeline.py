@@ -192,3 +192,18 @@ def test_pipeline_module_planner():
     assert pm.stage_layers(1) == [1]
     with pytest.raises(ValueError):
         PipelineModule.from_model(build_model("tiny", num_layers=3), num_stages=2)
+
+
+def test_partition_method_validation():
+    """'uniform'/'parameters' accepted (identical under stacked homogeneous
+    layers); unknown methods rejected; type-regex loudly unimplemented."""
+    from deepspeed_tpu.runtime.pipe.module import PipelineModule
+    from deepspeed_tpu.models import build_model
+    m = build_model("tiny")
+    u = PipelineModule(model=m, num_stages=2, partition_method="uniform")
+    p = PipelineModule(model=m, num_stages=2, partition_method="parameters")
+    assert u.layers_per_stage == p.layers_per_stage
+    with pytest.raises(ValueError):
+        PipelineModule(model=m, num_stages=2, partition_method="bogus")
+    with pytest.raises(NotImplementedError):
+        PipelineModule(model=m, num_stages=2, partition_method="type:attn")
