@@ -199,12 +199,14 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
         out = decode_attention(q, ck, cv, cache_len + s, bias=bias, window=window)
     else:
         impl = None if cfg.attn_impl == "auto" else cfg.attn_impl
-        bias = attn_bias
-        if cfg.position == "alibi" and bias is None:
-            pos = jnp.arange(x.shape[1])
-            bias = alibi_bias(cfg.num_heads, pos, pos)[None]  # (1, H, S, S)
+        slopes = None
+        if cfg.position == "alibi" and attn_bias is None:
+            # slopes, not a bias tensor: the flash kernel computes the
+            # ALiBi term in-kernel; XLA fallbacks expand it themselves
+            slopes = alibi_slopes(cfg.num_heads)
         out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids,
-                                  bias=bias, window=window, impl=impl)
+                                  bias=attn_bias, alibi_slopes=slopes,
+                                  window=window, impl=impl)
 
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if "bo" in params:

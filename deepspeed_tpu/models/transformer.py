@@ -255,12 +255,11 @@ class CausalLM:
 
         constrain = _activation_constraint()
 
+        # ALiBi needs no precomputed bias: apply_attention passes the
+        # per-head slopes down and the flash kernel builds the term
+        # in-kernel; XLA fallbacks expand slopes per layer (cheap next to
+        # the O(S^2) attention math they already do).
         attn_bias = None
-        if cfg.position == "alibi":
-            # layer-invariant: build ONCE outside the scan (inside, remat
-            # boundaries would re-materialize the O(H*S^2) tensor per layer)
-            pos = jnp.arange(input_ids.shape[1])
-            attn_bias = L.alibi_bias(cfg.num_heads, pos, pos)[None]
 
         windows = self._layer_windows()
 

@@ -74,8 +74,16 @@ def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def _alibi_bias_from_slopes(slopes, sq, sk):
+    """(H,) slopes → (1, H, Sq, Sk) additive bias (XLA fallback paths)."""
+    q_pos = jnp.arange(sq) + (sk - sq)
+    k_pos = jnp.arange(sk)
+    rel = (k_pos[None, :] - q_pos[:, None]).astype(jnp.float32)
+    return (jnp.asarray(slopes, jnp.float32)[:, None, None] * rel)[None]
+
+
 def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
-                        window=None, impl: Optional[str] = None):
+                        window=None, alibi_slopes=None, impl: Optional[str] = None):
     """Dispatching attention entry point.
 
     q: (B, S, H, D); k/v: (B, S, KVH, D). Returns (B, S, H, D).
@@ -83,7 +91,15 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     window: sliding-window width (Mistral/GPT-Neo local attention). A
     static int >= S is a no-op (dropped so flash stays eligible); a traced
     scalar or a binding window routes to the reference path.
+    alibi_slopes: (H,) per-head ALiBi slopes — handled IN-KERNEL by the
+    flash path (no O(S^2) bias tensor); expanded to a bias only for the
+    XLA fallback. Treated as non-differentiable constants. Mutually
+    exclusive with an explicit ``bias``.
     """
+    if bias is not None and alibi_slopes is not None:
+        raise ValueError(
+            "pass either an explicit additive bias or alibi_slopes, not "
+            "both (the slopes would be silently dropped)")
     if isinstance(window, int) and window >= q.shape[1]:
         window = None   # cannot bind: every key in range is visible anyway
     mesh = groups.get_mesh() if groups.mesh_is_initialized() else None
@@ -94,13 +110,15 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         if not causal:
             raise NotImplementedError("ring attention is causal-only")
         if seq_sharded:
-            if bias is not None or window is not None:
+            if bias is not None or window is not None or alibi_slopes is not None:
                 raise NotImplementedError(
                     "ring attention does not support additive attention bias "
                     "(ALiBi) or sliding windows; use Ulysses SP or "
                     "attn_impl='reference'")
             return ring_attention(q, k, v, scale=scale)
         # no seq axis: plain local attention
+        if alibi_slopes is not None and bias is None:
+            bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
         return reference_attention(q, k, v, causal=causal, bias=bias,
                                    segment_ids=segment_ids, scale=scale,
                                    window=window)
@@ -125,7 +143,8 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
                            window is None):
         try:
             from .pallas.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids, scale=scale)
+            out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                  scale=scale, alibi_slopes=alibi_slopes)
         except Exception as e:
             # A silent fallback here would quietly cost O(S^2) memory and a
             # large fraction of peak throughput — warn loudly, once per shape.
@@ -141,10 +160,14 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
                     q.shape, type(e).__name__, e)
             if impl == "flash":
                 raise
+            if alibi_slopes is not None and bias is None:
+                bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
             out = reference_attention(q, k, v, causal=causal, bias=bias,
                                       segment_ids=segment_ids, scale=scale,
                                       window=window)
     else:
+        if alibi_slopes is not None and bias is None:
+            bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
         out = reference_attention(q, k, v, causal=causal, bias=bias,
                                   segment_ids=segment_ids, scale=scale,
                                   window=window)

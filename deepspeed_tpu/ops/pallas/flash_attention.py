@@ -33,11 +33,13 @@ def _interpret() -> bool:
 # forward
 # ----------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alibi,
+                block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                      # (Bq, D) input dtype
     seq_k = k_ref.shape[2]
     num_kv = seq_k // block_k
+    slope = slopes_ref[pl.program_id(1), 0] if alibi else None
     if causal:
         # last kv block that intersects rows [qi*Bq, (qi+1)*Bq)
         kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
@@ -54,9 +56,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_q, block_k
             v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)           # (Bq, Bk)
-            if masked:
+            if alibi or masked:
                 rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
                 cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            if alibi:   # in-kernel ALiBi: no (H, S, S) bias ever touches HBM
+                s = s + slope * (cols - rows).astype(jnp.float32)
+            if masked:
                 s = jnp.where(rows >= cols, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=1))
             alpha = jnp.exp(m - m_new)
@@ -78,20 +83,21 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, causal, block_q, block_k
     lse_ref[0, 0, 0] = m + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, causal, block_q, block_k):
+def _fwd(q, k, v, slopes, causal, alibi, block_q, block_k):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grid = (b, h, sq // block_q)
     group = h // kvh
 
     out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, causal=causal,
+        functools.partial(_fwd_kernel, causal=causal, alibi=alibi,
                           block_q=block_q, block_k=block_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
             pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
+            pl.BlockSpec((q.shape[1], 128), lambda bi, hi, qi: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -104,7 +110,7 @@ def _fwd(q, k, v, causal, block_q, block_k):
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v)
+    )(q, k, v, slopes)
     return out, lse
 
 
@@ -112,13 +118,14 @@ def _fwd(q, k, v, causal, block_q, block_k):
 # backward
 # ----------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               causal, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_ref, *,
+               causal, alibi, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
     lse = lse_ref[0, 0, 0]
     delta = delta_ref[0, 0, 0]
+    slope = slopes_ref[pl.program_id(1), 0] if alibi else None
     seq_k = k_ref.shape[2]
     num_kv = seq_k // block_k
     if causal:
@@ -134,9 +141,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
             v = v_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k), block_k), :]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            if masked:
+            if alibi or masked:
                 rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
                 cols = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            if alibi:
+                s = s + slope * (cols - rows).astype(jnp.float32)
+            if masked:
                 s = jnp.where(rows >= cols, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])                                   # (Bq, Bk)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -152,11 +162,12 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref, *,
-                causal, block_q, block_k):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
+                dk_ref, dv_ref, *, causal, alibi, block_q, block_k):
     ki = pl.program_id(2)
     k = k_ref[0, 0]                                       # (Bk, D)
     v = v_ref[0, 0]
+    slope = slopes_ref[pl.program_id(1), 0] if alibi else None
     seq_q = q_ref.shape[2]
     num_q = seq_q // block_q
     if causal:
@@ -176,9 +187,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
             delta = delta_ref[0, 0, 0, pl.ds(pl.multiple_of(i * block_q, block_q), block_q)]
             s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)          # (Bq, Bk)
-            if masked:
+            if alibi or masked:
                 rows = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
                 cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            if alibi:
+                s = s + slope * (cols - rows).astype(jnp.float32)
+            if masked:
                 s = jnp.where(rows >= cols, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -199,8 +213,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, block_q, block_k, residuals, g):
-    q, k, v, out, lse = residuals
+def _bwd(causal, alibi, block_q, block_k, residuals, g):
+    q, k, v, slopes, out, lse = residuals
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     group = h // kvh
@@ -209,7 +223,7 @@ def _bwd(causal, block_q, block_k, residuals, g):
                     axis=-1)[:, :, None, :]  # (B,H,1,Sq)
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal,
+        functools.partial(_dq_kernel, causal=causal, alibi=alibi,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, sq // block_q),
         in_specs=[
@@ -219,17 +233,18 @@ def _bwd(causal, block_q, block_k, residuals, g):
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
             pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec((q.shape[1], 128), lambda bi, hi, qi: (0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, slopes)
 
     sk = k.shape[2]
     dk_h, dv_h = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal,
+        functools.partial(_dkv_kernel, causal=causal, alibi=alibi,
                           block_q=block_q, block_k=block_k),
         grid=(b, h, sk // block_k),
         in_specs=[
@@ -239,6 +254,7 @@ def _bwd(causal, block_q, block_k, residuals, g):
             pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki_: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki_: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki_: (bi, hi, 0, 0)),
+            pl.BlockSpec((q.shape[1], 128), lambda bi, hi, ki_: (0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki_: (bi, hi, ki_, 0)),
@@ -251,41 +267,50 @@ def _bwd(causal, block_q, block_k, residuals, g):
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, delta, slopes)
 
     if group > 1:
         dk = dk_h.reshape(b, kvh, group, sk, d).sum(axis=2).astype(k.dtype)
         dv = dv_h.reshape(b, kvh, group, sk, d).sum(axis=2).astype(v.dtype)
     else:
         dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
-    return dq, dk, dv
+    return dq, dk, dv, jnp.zeros_like(slopes)
 
 
 # ----------------------------------------------------------------------
 # public API
 # ----------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal, block_q, block_k):
-    """Scale-free core: callers fold the softmax scale into q."""
-    out, _ = _fwd(q, k, v, causal, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_bhsd(q, k, v, slopes, causal, alibi, block_q, block_k):
+    """Scale-free core: callers fold the softmax scale into q.
+
+    ``slopes``: (H, 128) fp32 per-head ALiBi slopes (lane-broadcast; a
+    zeros placeholder when ``alibi`` is False)."""
+    out, _ = _fwd(q, k, v, slopes, causal, alibi, block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, block_q, block_k):
-    out, lse = _fwd(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _flash_fwd_rule(q, k, v, slopes, causal, alibi, block_q, block_k):
+    out, lse = _fwd(q, k, v, slopes, causal, alibi, block_q, block_k)
+    return out, (q, k, v, slopes, out, lse)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
+                    alibi_slopes=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """q: (B, S, H, D); k/v: (B, S, KVH, D) → (B, S, H, D).
 
     Requires S % block == 0 and D in {64, 128, 256}; callers
     (``ops/attention.py``) fall back to the XLA path otherwise.
+    ``alibi_slopes``: (H,) per-head slopes — the bias slope*(k-q) is
+    computed inside the kernel from block coordinates (no O(S^2) bias in
+    HBM), fwd and bwd. Slopes are NON-DIFFERENTIABLE here (the vjp
+    returns zero for them): ALiBi slopes are fixed constants, not
+    trainable parameters.
     """
     if segment_ids is not None:
         raise NotImplementedError("flash_attention: segment_ids not supported; use reference path")
@@ -295,11 +320,18 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     if s % block_q != 0 or s % block_k != 0:
         raise ValueError(f"seq len {s} not divisible by blocks ({block_q},{block_k})")
     scale = scale if scale is not None else d ** -0.5
+    alibi = alibi_slopes is not None
+    if alibi:
+        slopes = jnp.broadcast_to(
+            jnp.asarray(alibi_slopes, jnp.float32)[:, None], (h, 128))
+    else:
+        slopes = jnp.zeros((h, 128), jnp.float32)
     # Fold the softmax scale into q outside the custom_vjp: the kernels run
     # scale-free (one fewer VPU pass over every (Bq, Bk) score tile, fwd and
     # bwd) and autodiff chains d(q*scale)/dq for free.
     qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, bool(causal), int(block_q), int(block_k))
+    out = _flash_bhsd(qt, kt, vt, slopes, bool(causal), alibi,
+                      int(block_q), int(block_k))
     return out.transpose(0, 2, 1, 3)
