@@ -82,6 +82,17 @@ def _alibi_bias_from_slopes(slopes, sq, sk):
     return (jnp.asarray(slopes, jnp.float32)[:, None, None] * rel)[None]
 
 
+def _reference_with_slopes(q, k, v, causal, bias, alibi_slopes, segment_ids,
+                           scale, window):
+    """Single fallback entry: expand ALiBi slopes to a bias and run the XLA
+    reference path (keeps the expansion in exactly one place)."""
+    if alibi_slopes is not None and bias is None:
+        bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
+    return reference_attention(q, k, v, causal=causal, bias=bias,
+                               segment_ids=segment_ids, scale=scale,
+                               window=window)
+
+
 def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
                         window=None, alibi_slopes=None, impl: Optional[str] = None):
     """Dispatching attention entry point.
@@ -100,8 +111,8 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         raise ValueError(
             "pass either an explicit additive bias or alibi_slopes, not "
             "both (the slopes would be silently dropped)")
-    if isinstance(window, int) and window >= q.shape[1]:
-        window = None   # cannot bind: every key in range is visible anyway
+    if isinstance(window, int) and (window >= q.shape[1] or window <= 0):
+        window = None   # cannot bind (or the <=0 "global" sentinel)
     mesh = groups.get_mesh() if groups.mesh_is_initialized() else None
     seq_sharded = mesh is not None and mesh.shape.get("seq", 1) > 1
 
@@ -117,11 +128,8 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
                     "attn_impl='reference'")
             return ring_attention(q, k, v, scale=scale)
         # no seq axis: plain local attention
-        if alibi_slopes is not None and bias is None:
-            bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
-        return reference_attention(q, k, v, causal=causal, bias=bias,
-                                   segment_ids=segment_ids, scale=scale,
-                                   window=window)
+        return _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
+                                      segment_ids, scale, window)
 
     if seq_sharded:
         # Ulysses: swap sequence-sharding for head-sharding around the local
@@ -132,19 +140,24 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
         v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
 
-    if impl == "flash" and (bias is not None or window is not None):
+    # flash handles static-int causal windows in-kernel (block skipping);
+    # traced per-layer windows (scan over local/global patterns) cannot be
+    # static and stay on the reference path
+    flash_window_ok = window is None or (isinstance(window, int) and causal)
+    if impl == "flash" and (bias is not None or not flash_window_ok):
         raise NotImplementedError(
             "the Pallas flash kernel does not take an additive attention "
-            "bias (ALiBi) or a binding sliding window; use "
+            "bias tensor or a traced/non-causal sliding window; use "
             "attn_impl='reference' (auto dispatch already routes these "
             "there)")
     if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
                            q.shape[3] in (64, 128, 256) and bias is None and
-                           window is None):
+                           flash_window_ok):
         try:
             from .pallas.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                                  scale=scale, alibi_slopes=alibi_slopes)
+                                  scale=scale, alibi_slopes=alibi_slopes,
+                                  window=window)
         except Exception as e:
             # A silent fallback here would quietly cost O(S^2) memory and a
             # large fraction of peak throughput — warn loudly, once per shape.
@@ -160,17 +173,11 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
                     q.shape, type(e).__name__, e)
             if impl == "flash":
                 raise
-            if alibi_slopes is not None and bias is None:
-                bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
-            out = reference_attention(q, k, v, causal=causal, bias=bias,
-                                      segment_ids=segment_ids, scale=scale,
-                                      window=window)
+            out = _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
+                                         segment_ids, scale, window)
     else:
-        if alibi_slopes is not None and bias is None:
-            bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
-        out = reference_attention(q, k, v, causal=causal, bias=bias,
-                                  segment_ids=segment_ids, scale=scale,
-                                  window=window)
+        out = _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
+                                     segment_ids, scale, window)
 
     if seq_sharded:
         out = jax.lax.with_sharding_constraint(out, jax.NamedSharding(mesh, out_spec))

@@ -33,21 +33,41 @@ def _interpret() -> bool:
 # forward
 # ----------------------------------------------------------------------
 
+def _q_block_ranges(qi, block_q, block_k, num_kv, causal, window):
+    """KV-block loop bounds for q block qi: (kv_lo, full_lo, full_hi, kv_hi).
+
+    [kv_lo, full_lo) and [full_hi, kv_hi) run with masking; [full_lo,
+    full_hi) is mask-free. A sliding window both LOWERS kv_hi's
+    counterpart kv_lo (blocks left of every row's window are skipped —
+    the flash win for long-context Mistral) and shrinks the mask-free
+    middle from below.
+    """
+    if causal:
+        kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
+        n_full = (qi * block_q) // block_k
+    else:
+        kv_hi = num_kv
+        n_full = num_kv
+    if window is None:
+        return 0, 0, n_full, kv_hi
+    # first block holding any col visible to the block's first row
+    kv_lo = jax.lax.max(0, (qi * block_q - window + 1) // block_k)
+    # first block whose cols are inside the window of even the LAST row
+    lo_full = jax.lax.max(0, ((qi + 1) * block_q - window + block_k - 1) // block_k)
+    full_lo = jax.lax.clamp(kv_lo, lo_full, kv_hi)
+    full_hi = jax.lax.clamp(full_lo, n_full, kv_hi)
+    return kv_lo, full_lo, full_hi, kv_hi
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alibi,
-                block_q, block_k):
+                window, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                      # (Bq, D) input dtype
     seq_k = k_ref.shape[2]
     num_kv = seq_k // block_k
     slope = slopes_ref[pl.program_id(1), 0] if alibi else None
-    if causal:
-        # last kv block that intersects rows [qi*Bq, (qi+1)*Bq)
-        kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
-        # kv blocks below n_full lie strictly under the diagonal: no masking
-        n_full = (qi * block_q) // block_k
-    else:
-        kv_hi = num_kv
-        n_full = num_kv
+    kv_lo, full_lo, full_hi, kv_hi = _q_block_ranges(
+        qi, block_q, block_k, num_kv, causal, window)
 
     def make_body(masked):
         def body(j, carry):
@@ -62,7 +82,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alib
             if alibi:   # in-kernel ALiBi: no (H, S, S) bias ever touches HBM
                 s = s + slope * (cols - rows).astype(jnp.float32)
             if masked:
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                keep = rows >= cols if causal else \
+                    jnp.ones(s.shape, jnp.bool_)
+                if window is not None:
+                    keep = keep & (rows - cols < window)
+                s = jnp.where(keep, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=1))
             alpha = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, None])
@@ -76,14 +100,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alib
     m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
-    carry = jax.lax.fori_loop(0, n_full, make_body(False), (m0, l0, acc0))
-    m, l, acc = jax.lax.fori_loop(n_full, kv_hi, make_body(True), carry)
+    carry = jax.lax.fori_loop(kv_lo, full_lo, make_body(True),
+                              (m0, l0, acc0))
+    carry = jax.lax.fori_loop(full_lo, full_hi, make_body(False), carry)
+    m, l, acc = jax.lax.fori_loop(full_hi, kv_hi, make_body(True), carry)
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
     lse_ref[0, 0, 0] = m + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, slopes, causal, alibi, block_q, block_k):
+def _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grid = (b, h, sq // block_q)
@@ -91,7 +117,7 @@ def _fwd(q, k, v, slopes, causal, alibi, block_q, block_k):
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, alibi=alibi,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -119,7 +145,7 @@ def _fwd(q, k, v, slopes, causal, alibi, block_q, block_k):
 # ----------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_ref, *,
-               causal, alibi, block_q, block_k):
+               causal, alibi, window, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
@@ -128,12 +154,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
     slope = slopes_ref[pl.program_id(1), 0] if alibi else None
     seq_k = k_ref.shape[2]
     num_kv = seq_k // block_k
-    if causal:
-        kv_hi = jax.lax.min((((qi + 1) * block_q + block_k - 1) // block_k), num_kv)
-        n_full = (qi * block_q) // block_k
-    else:
-        kv_hi = num_kv
-        n_full = num_kv
+    kv_lo, full_lo, full_hi, kv_hi = _q_block_ranges(
+        qi, block_q, block_k, num_kv, causal, window)
 
     def make_body(masked):
         def body(j, dq):
@@ -147,7 +169,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
             if alibi:
                 s = s + slope * (cols - rows).astype(jnp.float32)
             if masked:
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                keep = rows >= cols if causal else jnp.ones(s.shape, jnp.bool_)
+                if window is not None:
+                    keep = keep & (rows - cols < window)
+                s = jnp.where(keep, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])                                   # (Bq, Bk)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -156,14 +181,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
                                             preferred_element_type=jnp.float32)
         return body
 
-    dq = jax.lax.fori_loop(0, n_full, make_body(False),
+    dq = jax.lax.fori_loop(kv_lo, full_lo, make_body(True),
                            jnp.zeros((block_q, q.shape[-1]), jnp.float32))
-    dq = jax.lax.fori_loop(n_full, kv_hi, make_body(True), dq)
+    dq = jax.lax.fori_loop(full_lo, full_hi, make_body(False), dq)
+    dq = jax.lax.fori_loop(full_hi, kv_hi, make_body(True), dq)
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
-                dk_ref, dv_ref, *, causal, alibi, block_q, block_k):
+                dk_ref, dv_ref, *, causal, alibi, window, block_q, block_k):
     ki = pl.program_id(2)
     k = k_ref[0, 0]                                       # (Bk, D)
     v = v_ref[0, 0]
@@ -177,6 +203,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
     else:
         q_lo = 0
         i_um = 0
+    if window is not None:
+        # dual of _q_block_ranges: rows past the window of the block's last
+        # col contribute nothing (r < c + window); the mask-free middle ends
+        # once the block's LAST row leaves the window of the first col
+        q_hi_w = jax.lax.min(num_q,
+                             ((ki + 1) * block_k - 1 + window + block_q - 1) // block_q)
+        i_full_end = jax.lax.max(q_lo, (ki * block_k + window) // block_q)
+    else:
+        q_hi_w = num_q
+        i_full_end = num_q
 
     def make_body(masked):
         def body(i, carry):
@@ -193,7 +229,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
             if alibi:
                 s = s + slope * (cols - rows).astype(jnp.float32)
             if masked:
-                s = jnp.where(rows >= cols, s, NEG_INF)
+                keep = rows >= cols if causal else jnp.ones(s.shape, jnp.bool_)
+                if window is not None:
+                    keep = keep & (rows - cols < window)
+                s = jnp.where(keep, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                                               preferred_element_type=jnp.float32)
@@ -206,14 +245,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
         return body
 
     zeros = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    hi = jax.lax.min(i_um, num_q) if causal else 0
-    dk, dv = jax.lax.fori_loop(q_lo, hi, make_body(True), (zeros, zeros))
-    dk, dv = jax.lax.fori_loop(hi, num_q, make_body(False), (dk, dv))
+    m1_end = jax.lax.clamp(q_lo, jax.lax.min(i_um, num_q) if causal else 0, q_hi_w)
+    full_end = jax.lax.clamp(m1_end, i_full_end, q_hi_w)
+    dk, dv = jax.lax.fori_loop(q_lo, m1_end, make_body(True), (zeros, zeros))
+    dk, dv = jax.lax.fori_loop(m1_end, full_end, make_body(False), (dk, dv))
+    dk, dv = jax.lax.fori_loop(full_end, q_hi_w, make_body(True), (dk, dv))
     dk_ref[0, 0] = dk.astype(dk_ref.dtype)
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, alibi, block_q, block_k, residuals, g):
+def _bwd(causal, alibi, window, block_q, block_k, residuals, g):
     q, k, v, slopes, out, lse = residuals
     b, h, sq, d = q.shape
     kvh = k.shape[1]
@@ -224,7 +265,7 @@ def _bwd(causal, alibi, block_q, block_k, residuals, g):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, alibi=alibi,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k),
         grid=(b, h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -245,7 +286,7 @@ def _bwd(causal, alibi, block_q, block_k, residuals, g):
     sk = k.shape[2]
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, alibi=alibi,
-                          block_q=block_q, block_k=block_k),
+                          window=window, block_q=block_q, block_k=block_k),
         grid=(b, h, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki_: (bi, hi, 0, 0)),
@@ -281,18 +322,18 @@ def _bwd(causal, alibi, block_q, block_k, residuals, g):
 # public API
 # ----------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_bhsd(q, k, v, slopes, causal, alibi, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash_bhsd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
     """Scale-free core: callers fold the softmax scale into q.
 
     ``slopes``: (H, 128) fp32 per-head ALiBi slopes (lane-broadcast; a
     zeros placeholder when ``alibi`` is False)."""
-    out, _ = _fwd(q, k, v, slopes, causal, alibi, block_q, block_k)
+    out, _ = _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, slopes, causal, alibi, block_q, block_k):
-    out, lse = _fwd(q, k, v, slopes, causal, alibi, block_q, block_k)
+def _flash_fwd_rule(q, k, v, slopes, causal, alibi, window, block_q, block_k):
+    out, lse = _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k)
     return out, (q, k, v, slopes, out, lse)
 
 
@@ -300,7 +341,7 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
-                    alibi_slopes=None,
+                    alibi_slopes=None, window=None,
                     block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
     """q: (B, S, H, D); k/v: (B, S, KVH, D) → (B, S, H, D).
 
@@ -314,6 +355,11 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     """
     if segment_ids is not None:
         raise NotImplementedError("flash_attention: segment_ids not supported; use reference path")
+    if window is not None:
+        if not causal:
+            raise NotImplementedError("flash sliding window is causal-only")
+        if not isinstance(window, int) or window <= 0:
+            raise ValueError("flash window must be a static positive int")
     b, s, h, d = q.shape
     block_q = min(block_q, s)
     block_k = min(block_k, s)
@@ -332,6 +378,6 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, slopes, bool(causal), alibi,
+    out = _flash_bhsd(qt, kt, vt, slopes, bool(causal), alibi, window,
                       int(block_q), int(block_k))
     return out.transpose(0, 2, 1, 3)
