@@ -13,6 +13,8 @@ Layout inside the kernel: (B, H, S, D). The public wrapper takes the model's
 import functools
 import math
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -59,15 +61,19 @@ def _q_block_ranges(qi, block_q, block_k, num_kv, causal, window):
     return kv_lo, full_lo, full_hi, kv_hi
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alibi,
-                window, block_q, block_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, seg_ref, o_ref, lse_ref, *, causal,
+                alibi, segmented, window, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]                                      # (Bq, D) input dtype
     seq_k = k_ref.shape[2]
     num_kv = seq_k // block_k
     slope = slopes_ref[pl.program_id(1), 0] if alibi else None
+    qseg = seg_ref[0, 0, pl.ds(pl.multiple_of(qi * block_q, block_q), block_q)] \
+        if segmented else None
     kv_lo, full_lo, full_hi, kv_hi = _q_block_ranges(
         qi, block_q, block_k, num_kv, causal, window)
+    if segmented:
+        full_lo, full_hi = kv_lo, kv_lo   # every block needs the seg mask
 
     def make_body(masked):
         def body(j, carry):
@@ -86,6 +92,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alib
                     jnp.ones(s.shape, jnp.bool_)
                 if window is not None:
                     keep = keep & (rows - cols < window)
+                if segmented:   # packed sequences: attend within segment only
+                    kseg = seg_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k),
+                                               block_k)]
+                    keep = keep & (qseg[:, None] == kseg[None, :])
                 s = jnp.where(keep, s, NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=1))
             alpha = jnp.exp(m - m_new)
@@ -109,7 +119,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, slopes_ref, o_ref, lse_ref, *, causal, alib
     lse_ref[0, 0, 0] = m + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
+def _fwd(q, k, v, slopes, seg, causal, alibi, segmented, window, block_q, block_k):
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     grid = (b, h, sq // block_q)
@@ -117,13 +127,15 @@ def _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, causal=causal, alibi=alibi,
-                          window=window, block_q=block_q, block_k=block_k),
+                          segmented=segmented, window=window,
+                          block_q=block_q, block_k=block_k),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
             pl.BlockSpec((1, 1, k.shape[2], d), lambda bi, hi, qi: (bi, hi // group, 0, 0)),
             pl.BlockSpec((q.shape[1], 128), lambda bi, hi, qi: (0, 0)),
+            pl.BlockSpec((1, 1, seg.shape[2]), lambda bi, hi, qi: (bi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -136,7 +148,7 @@ def _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v, slopes)
+    )(q, k, v, slopes, seg)
     return out, lse
 
 
@@ -144,18 +156,22 @@ def _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
 # backward
 # ----------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_ref, *,
-               causal, alibi, window, block_q, block_k):
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, seg_ref,
+               dq_ref, *, causal, alibi, segmented, window, block_q, block_k):
     qi = pl.program_id(2)
     q = q_ref[0, 0]
     do = do_ref[0, 0]
     lse = lse_ref[0, 0, 0]
     delta = delta_ref[0, 0, 0]
     slope = slopes_ref[pl.program_id(1), 0] if alibi else None
+    qseg = seg_ref[0, 0, pl.ds(pl.multiple_of(qi * block_q, block_q), block_q)] \
+        if segmented else None
     seq_k = k_ref.shape[2]
     num_kv = seq_k // block_k
     kv_lo, full_lo, full_hi, kv_hi = _q_block_ranges(
         qi, block_q, block_k, num_kv, causal, window)
+    if segmented:
+        full_lo, full_hi = kv_lo, kv_lo
 
     def make_body(masked):
         def body(j, dq):
@@ -172,6 +188,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
                 keep = rows >= cols if causal else jnp.ones(s.shape, jnp.bool_)
                 if window is not None:
                     keep = keep & (rows - cols < window)
+                if segmented:
+                    kseg = seg_ref[0, 0, pl.ds(pl.multiple_of(j * block_k, block_k),
+                                               block_k)]
+                    keep = keep & (qseg[:, None] == kseg[None, :])
                 s = jnp.where(keep, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])                                   # (Bq, Bk)
             dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -188,12 +208,14 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, dq_r
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
-                dk_ref, dv_ref, *, causal, alibi, window, block_q, block_k):
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref, seg_ref,
+                dk_ref, dv_ref, *, causal, alibi, segmented, window, block_q, block_k):
     ki = pl.program_id(2)
     k = k_ref[0, 0]                                       # (Bk, D)
     v = v_ref[0, 0]
     slope = slopes_ref[pl.program_id(1), 0] if alibi else None
+    kseg = seg_ref[0, 0, pl.ds(pl.multiple_of(ki * block_k, block_k), block_k)] \
+        if segmented else None
     seq_q = q_ref.shape[2]
     num_q = seq_q // block_q
     if causal:
@@ -232,6 +254,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
                 keep = rows >= cols if causal else jnp.ones(s.shape, jnp.bool_)
                 if window is not None:
                     keep = keep & (rows - cols < window)
+                if segmented:
+                    qseg = seg_ref[0, 0, pl.ds(pl.multiple_of(i * block_q, block_q),
+                                               block_q)]
+                    keep = keep & (qseg[:, None] == kseg[None, :])
                 s = jnp.where(keep, s, NEG_INF)
             p = jnp.exp(s - lse[:, None])
             dv_new = dv + jax.lax.dot_general(p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -245,8 +271,12 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
         return body
 
     zeros = jnp.zeros((block_k, k.shape[-1]), jnp.float32)
-    m1_end = jax.lax.clamp(q_lo, jax.lax.min(i_um, num_q) if causal else 0, q_hi_w)
-    full_end = jax.lax.clamp(m1_end, i_full_end, q_hi_w)
+    if segmented:   # every q block needs the segment mask
+        m1_end = q_hi_w
+        full_end = q_hi_w
+    else:
+        m1_end = jax.lax.clamp(q_lo, jax.lax.min(i_um, num_q) if causal else 0, q_hi_w)
+        full_end = jax.lax.clamp(m1_end, i_full_end, q_hi_w)
     dk, dv = jax.lax.fori_loop(q_lo, m1_end, make_body(True), (zeros, zeros))
     dk, dv = jax.lax.fori_loop(m1_end, full_end, make_body(False), (dk, dv))
     dk, dv = jax.lax.fori_loop(full_end, q_hi_w, make_body(True), (dk, dv))
@@ -254,8 +284,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, slopes_ref,
     dv_ref[0, 0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(causal, alibi, window, block_q, block_k, residuals, g):
-    q, k, v, slopes, out, lse = residuals
+def _bwd(causal, alibi, segmented, window, block_q, block_k, residuals, g):
+    q, k, v, slopes, seg, out, lse = residuals
     b, h, sq, d = q.shape
     kvh = k.shape[1]
     group = h // kvh
@@ -265,7 +295,8 @@ def _bwd(causal, alibi, window, block_q, block_k, residuals, g):
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, alibi=alibi,
-                          window=window, block_q=block_q, block_k=block_k),
+                          segmented=segmented, window=window,
+                          block_q=block_q, block_k=block_k),
         grid=(b, h, sq // block_q),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -275,18 +306,20 @@ def _bwd(causal, alibi, window, block_q, block_k, residuals, g):
             pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
             pl.BlockSpec((1, 1, 1, block_q), lambda bi, hi, qi: (bi, hi, 0, qi)),
             pl.BlockSpec((q.shape[1], 128), lambda bi, hi, qi: (0, 0)),
+            pl.BlockSpec((1, 1, seg.shape[2]), lambda bi, hi, qi: (bi, 0, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v, do, lse, delta, slopes)
+    )(q, k, v, do, lse, delta, slopes, seg)
 
     sk = k.shape[2]
     dk_h, dv_h = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, alibi=alibi,
-                          window=window, block_q=block_q, block_k=block_k),
+                          segmented=segmented, window=window,
+                          block_q=block_q, block_k=block_k),
         grid=(b, h, sk // block_k),
         in_specs=[
             pl.BlockSpec((1, 1, sq, d), lambda bi, hi, ki_: (bi, hi, 0, 0)),
@@ -296,6 +329,7 @@ def _bwd(causal, alibi, window, block_q, block_k, residuals, g):
             pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki_: (bi, hi, 0, 0)),
             pl.BlockSpec((1, 1, 1, sq), lambda bi, hi, ki_: (bi, hi, 0, 0)),
             pl.BlockSpec((q.shape[1], 128), lambda bi, hi, ki_: (0, 0)),
+            pl.BlockSpec((1, 1, seg.shape[2]), lambda bi, hi, ki_: (bi, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d), lambda bi, hi, ki_: (bi, hi, ki_, 0)),
@@ -308,33 +342,37 @@ def _bwd(causal, alibi, window, block_q, block_k, residuals, g):
         interpret=_interpret(),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
-    )(q, k, v, do, lse, delta, slopes)
+    )(q, k, v, do, lse, delta, slopes, seg)
 
     if group > 1:
         dk = dk_h.reshape(b, kvh, group, sk, d).sum(axis=2).astype(k.dtype)
         dv = dv_h.reshape(b, kvh, group, sk, d).sum(axis=2).astype(v.dtype)
     else:
         dk, dv = dk_h.astype(k.dtype), dv_h.astype(v.dtype)
-    return dq, dk, dv, jnp.zeros_like(slopes)
+    return dq, dk, dv, jnp.zeros_like(slopes), \
+        np.zeros(seg.shape, jax.dtypes.float0)
 
 
 # ----------------------------------------------------------------------
 # public API
 # ----------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash_bhsd(q, k, v, slopes, causal, alibi, window, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash_bhsd(q, k, v, slopes, seg, causal, alibi, segmented, window, block_q, block_k):
     """Scale-free core: callers fold the softmax scale into q.
 
     ``slopes``: (H, 128) fp32 per-head ALiBi slopes (lane-broadcast; a
     zeros placeholder when ``alibi`` is False)."""
-    out, _ = _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k)
+    out, _ = _fwd(q, k, v, slopes, seg, causal, alibi, segmented, window,
+                  block_q, block_k)
     return out
 
 
-def _flash_fwd_rule(q, k, v, slopes, causal, alibi, window, block_q, block_k):
-    out, lse = _fwd(q, k, v, slopes, causal, alibi, window, block_q, block_k)
-    return out, (q, k, v, slopes, out, lse)
+def _flash_fwd_rule(q, k, v, slopes, seg, causal, alibi, segmented, window,
+                    block_q, block_k):
+    out, lse = _fwd(q, k, v, slopes, seg, causal, alibi, segmented, window,
+                    block_q, block_k)
+    return out, (q, k, v, slopes, seg, out, lse)
 
 
 _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
@@ -353,8 +391,6 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     returns zero for them): ALiBi slopes are fixed constants, not
     trainable parameters.
     """
-    if segment_ids is not None:
-        raise NotImplementedError("flash_attention: segment_ids not supported; use reference path")
     if window is not None:
         if not causal:
             raise NotImplementedError("flash sliding window is causal-only")
@@ -366,6 +402,11 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     if s % block_q != 0 or s % block_k != 0:
         raise ValueError(f"seq len {s} not divisible by blocks ({block_q},{block_k})")
     scale = scale if scale is not None else d ** -0.5
+    segmented = segment_ids is not None
+    if segmented:
+        seg = jnp.asarray(segment_ids, jnp.int32)[:, None, :]   # (B, 1, S)
+    else:
+        seg = jnp.zeros((b, 1, 128), jnp.int32)
     alibi = alibi_slopes is not None
     if alibi:
         slopes = jnp.broadcast_to(
@@ -378,6 +419,6 @@ def flash_attention(q, k, v, *, causal=True, segment_ids=None, scale=None,
     qt = (q * jnp.asarray(scale, q.dtype)).transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
-    out = _flash_bhsd(qt, kt, vt, slopes, bool(causal), alibi, window,
-                      int(block_q), int(block_k))
+    out = _flash_bhsd(qt, kt, vt, slopes, seg, bool(causal), alibi, segmented,
+                      window, int(block_q), int(block_k))
     return out.transpose(0, 2, 1, 3)
