@@ -1,0 +1,1 @@
+from .packing import pack_sequences  # noqa: F401

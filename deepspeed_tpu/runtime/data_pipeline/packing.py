@@ -1,0 +1,66 @@
+"""Sequence packing: many short documents per training row.
+
+Analog of the reference's packed-sample efficiency machinery (the
+``DeepSpeedDataSampler``'s variable-batch regime and Megatron-style packed
+pretraining data): short documents are first-fit packed into fixed-length
+rows, and the batch carries everything the model needs to keep them
+independent — ``segment_ids`` (masked in-kernel by the flash attention
+kernels), per-document ``positions`` (RoPE/learned embeddings restart at
+each document), and a ``loss_mask`` zeroing padding.
+
+Padding uses segment id 0 (pads attend only pads; their loss is masked),
+documents are 1-based.
+"""
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+
+def pack_sequences(docs: Sequence[Sequence[int]], seq_len: int,
+                   pad_id: int = 0) -> Dict[str, np.ndarray]:
+    """First-fit pack token lists into (N, seq_len) rows.
+
+    Returns dict(input_ids, labels, segment_ids, positions, loss_mask);
+    ``labels == input_ids`` with padding masked via ``loss_mask`` (the
+    engine's causal-LM loss convention). Documents longer than ``seq_len``
+    are split into ``seq_len``-sized pieces (each piece becomes its own
+    segment, matching the reference's sample-splitting behavior).
+    """
+    pieces: List[List[int]] = []
+    for d in docs:
+        d = list(d)
+        if not d:
+            continue
+        for i in range(0, len(d), seq_len):
+            pieces.append(d[i:i + seq_len])
+    # first-fit decreasing: longest pieces first fill rows tighter
+    pieces.sort(key=len, reverse=True)
+    rows: List[List[List[int]]] = []
+    space: List[int] = []
+    for p in pieces:
+        for r, free in enumerate(space):
+            if len(p) <= free:
+                rows[r].append(p)
+                space[r] -= len(p)
+                break
+        else:
+            rows.append([p])
+            space.append(seq_len - len(p))
+
+    n = len(rows)
+    ids = np.full((n, seq_len), pad_id, np.int32)
+    seg = np.zeros((n, seq_len), np.int32)
+    pos = np.zeros((n, seq_len), np.int32)
+    mask = np.zeros((n, seq_len), np.float32)
+    for r, row in enumerate(rows):
+        off = 0
+        for s_idx, p in enumerate(row, start=1):
+            ln = len(p)
+            ids[r, off:off + ln] = p
+            seg[r, off:off + ln] = s_idx
+            pos[r, off:off + ln] = np.arange(ln)
+            mask[r, off:off + ln] = 1.0
+            off += ln
+    return {"input_ids": ids, "labels": ids.copy(), "segment_ids": seg,
+            "positions": pos, "loss_mask": mask}
