@@ -89,6 +89,32 @@ class WandbMonitor(Monitor):
             self.wandb.log({tag: float(value)}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Comet ML fan-out (reference ``monitor/comet.py``); import-gated the
+    same way as W&B — absence of the SDK degrades to disabled, not error."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        self.enabled = False
+        if config.enabled and jax.process_index() == 0:
+            try:
+                import comet_ml
+                self.experiment = comet_ml.Experiment(
+                    api_key=config.api_key, project_name=config.project,
+                    workspace=config.workspace)
+                if config.experiment_name:
+                    self.experiment.set_name(config.experiment_name)
+                self.enabled = True
+            except Exception as e:
+                logger.warning(f"comet_ml unavailable: {e}")
+
+    def write_events(self, events: List[Event]):
+        if not self.enabled:
+            return
+        for tag, value, step in events:
+            self.experiment.log_metric(tag, float(value), step=step)
+
+
 class MonitorMaster(Monitor):
     def __init__(self, monitor_config):
         super().__init__(monitor_config)
@@ -102,6 +128,8 @@ class MonitorMaster(Monitor):
             self.monitors.append(CSVMonitor(monitor_config.csv_monitor))
         if monitor_config.wandb.enabled:
             self.monitors.append(WandbMonitor(monitor_config.wandb))
+        if monitor_config.comet.enabled:
+            self.monitors.append(CometMonitor(monitor_config.comet))
         self.enabled = any(m.enabled for m in self.monitors)
 
     def write_events(self, events: List[Event]):

@@ -127,14 +127,24 @@ class MonitorConfigBlock(DeepSpeedConfigModel):
     project: Optional[str] = None
 
 
+class CometConfigBlock(MonitorConfigBlock):
+    """Comet-only settings (reference monitor/config.py CometConfig) — a
+    separate block so other monitors' configs reject these keys."""
+    workspace: Optional[str] = None
+    api_key: Optional[str] = None
+    experiment_name: Optional[str] = None
+
+
 class DeepSpeedMonitorConfig(DeepSpeedConfigModel):
     tensorboard: MonitorConfigBlock = MonitorConfigBlock()
     csv_monitor: MonitorConfigBlock = MonitorConfigBlock()
     wandb: MonitorConfigBlock = MonitorConfigBlock()
+    comet: CometConfigBlock = CometConfigBlock()
 
     @property
     def enabled(self):
-        return self.tensorboard.enabled or self.csv_monitor.enabled or self.wandb.enabled
+        return (self.tensorboard.enabled or self.csv_monitor.enabled
+                or self.wandb.enabled or self.comet.enabled)
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

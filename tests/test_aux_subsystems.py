@@ -411,3 +411,16 @@ def test_compression_scheduler_offsets(rng):
     p2 = sched.apply(params)
     zeros = float(jnp.sum(p2["mlp"]["w"] == 0.0))
     assert zeros >= 32 * 32 * 0.5                   # pruned to dense_ratio
+
+
+def test_comet_monitor_config_and_degradation():
+    """Comet joins the monitor fan-out (reference monitor/comet.py); absent
+    SDK degrades to disabled without erroring, and events still flow."""
+    from deepspeed_tpu.runtime.config import DeepSpeedMonitorConfig
+    from deepspeed_tpu.monitor.monitor import CometMonitor, MonitorMaster
+    cfg = DeepSpeedMonitorConfig(comet={"enabled": True, "project": "p",
+                                        "workspace": "w"})
+    assert cfg.enabled
+    m = MonitorMaster(cfg)
+    assert any(isinstance(x, CometMonitor) for x in m.monitors)
+    m.write_events([("loss", 1.0, 1)])   # no-op when SDK missing, no raise
