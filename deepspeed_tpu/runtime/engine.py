@@ -1004,6 +1004,136 @@ class DeepSpeedEngine:
             return self.lr_scheduler.get_lr()
         return [self.optimizer.hyper.get("lr", 0.0)]
 
+    def set_lr(self, lr):
+        """Override the optimizer lr (reference ``engine.py set_lr``); with a
+        scheduler attached the scheduler keeps authority, as in the
+        reference."""
+        if self.optimizer is not None:
+            self.optimizer.hyper["lr"] = float(lr)
+        if self._infinity is not None:
+            self._infinity.adam.lr = float(lr)
+        # _next_lr_device's cache is value-keyed; no invalidation needed
+
+    # -- dynamic batch sizing (reference engine.py set_train_batch_size:
+    #    only the accumulation depth changes; the per-chip microbatch and
+    #    therefore the compiled step shape stay fixed) --
+
+    def set_train_batch_size(self, train_batch_size: int):
+        mbs = self.train_micro_batch_size_per_gpu()
+        dp = groups.get_data_parallel_world_size()
+        if train_batch_size % (mbs * dp) != 0:
+            raise ValueError(
+                f"train_batch_size {train_batch_size} must be a multiple of "
+                f"micro_batch*dp = {mbs * dp}")
+        self._config.gradient_accumulation_steps = train_batch_size // (mbs * dp)
+        self._config.train_batch_size = train_batch_size
+
+    def set_train_micro_batch_size(self, micro_batch_size: int):
+        """Change the per-chip microbatch; the next train_batch compiles the
+        new shape (XLA caches per shape, so alternating sizes is cheap
+        after first compile)."""
+        gas = self.gradient_accumulation_steps()
+        dp = groups.get_data_parallel_world_size()
+        self._config.train_micro_batch_size_per_gpu = int(micro_batch_size)
+        self._config.train_batch_size = int(micro_batch_size) * gas * dp
+
+    def set_gradient_accumulation_steps(self, gas: int):
+        mbs = self.train_micro_batch_size_per_gpu()
+        dp = groups.get_data_parallel_world_size()
+        self._config.gradient_accumulation_steps = int(gas)
+        self._config.train_batch_size = mbs * int(gas) * dp
+
+    def zero_grad(self):
+        """No-op for API parity: gradients are functional values produced
+        inside the compiled step, never accumulated module state."""
+
+    def load_module_state_dict(self, state_dict, strict: bool = True):
+        """Load a (native-layout) param pytree onto the engine's shardings,
+        re-seeding any fp32 master copies (host offload / bf16 masters) so
+        the next update starts from the loaded weights rather than the
+        stale masters."""
+        if self._infinity is not None:
+            raise NotImplementedError(
+                "ZeRO-Infinity streams params from its host/NVMe store; "
+                "load weights through load_checkpoint")
+        if strict:
+            ref = jax.tree.structure(self.module_params)
+            got = jax.tree.structure(state_dict)
+            if ref != got:
+                raise ValueError(
+                    f"state_dict tree mismatch: expected {ref}, got {got}")
+        self.module_params = jax.device_put(state_dict, self.param_shardings)
+        self._resync_masters_from_params()
+
+    def _resync_masters_from_params(self):
+        """fp32 masters (host offload, Twin-Flow halves, device master
+        slots) must track externally loaded module weights."""
+        def upd_slots(slots_tree, params_tree):
+            return jax.tree.map(
+                lambda s, p: ({**s, "master": p.astype(jnp.float32)}
+                              if "master" in s else s),
+                slots_tree, params_tree,
+                is_leaf=lambda x: isinstance(x, dict) and ("m" in x or "master" in x))
+
+        if self._host_optimizer is not None:
+            host = jax.device_get(self.module_params)
+            if self._twinflow is not None:
+                tdef, mask = self._twinflow["treedef"], self._twinflow["mask"]
+                flat = jax.tree.leaves(host)
+                host = tdef.unflatten(
+                    [p if m else None for p, m in zip(flat, mask)])
+                dev_params = self._twinflow["treedef"].unflatten(
+                    [p if not m else None
+                     for p, m in zip(jax.tree.leaves(self.module_params), mask)])
+                st = self._twinflow["dev_state"]
+                st["slots"] = upd_slots(st["slots"], dev_params)
+            self._host_optimizer.reset_masters(host)
+        elif isinstance(self.opt_state, dict) and "slots" in self.opt_state:
+            self._swap_in_opt_state()
+            self.opt_state = {**self.opt_state,
+                              "slots": upd_slots(self.opt_state["slots"],
+                                                 self.module_params)}
+
+    def save_16bit_model(self, save_dir, save_filename="pytorch_model.bin",
+                         exclude_frozen_parameters=False):
+        """Consolidate the (possibly ZeRO-sharded) params to one
+        low-precision torch-format state dict (reference
+        ``engine.py:3607``): keys are dotted native paths, values torch
+        tensors in the training dtype (bf16/fp16 when enabled)."""
+        import torch
+
+        if self._infinity is not None:
+            raise NotImplementedError(
+                "ZeRO-Infinity streams params from its host/NVMe store; "
+                "consolidate through save_checkpoint + zero_to_fp32")
+        dt = self.model.cfg.act_dtype if hasattr(self.model, "cfg") else None
+        host = jax.device_get(self.module_params)   # gathers ZeRO shards
+
+        flat = {}
+
+        def walk(prefix, node):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    walk(f"{prefix}.{k}" if prefix else k, v)
+            else:
+                a = np.asarray(node)
+                if a.dtype.name == "bfloat16":   # torch can't read ml_dtypes
+                    t = torch.from_numpy(
+                        a.astype(np.float32)).to(torch.bfloat16)
+                elif dt is not None and a.dtype == np.float32 and dt != jnp.float32:
+                    t = torch.from_numpy(a).to(
+                        torch.bfloat16 if dt == jnp.bfloat16 else torch.float16)
+                else:
+                    t = torch.from_numpy(np.ascontiguousarray(a))
+                flat[prefix] = t
+
+        walk("", host)
+        os.makedirs(save_dir, exist_ok=True)
+        path = os.path.join(save_dir, save_filename)
+        torch.save(flat, path)
+        log_dist(f"save_16bit_model: {len(flat)} tensors → {path}", ranks=[0])
+        return path
+
     def _current_lr(self):
         return float(self.get_lr()[0])
 

@@ -73,6 +73,18 @@ class HostOffloadOptimizer:
             self.adam.step(s["master"], gh, s["m"], s["v"], step_num, lr)
         return self.params()
 
+    def reset_masters(self, param_tree):
+        """Overwrite the fp32 masters in place from new module weights
+        (moments kept) — the sync the engine needs when weights are loaded
+        outside the checkpoint path, since every future update starts from
+        the masters, not the device params."""
+        def upd(s, p):
+            # fresh writable buffer: device_get views are read-only
+            s["master"] = np.array(p, np.float32)
+            return s
+        jax.tree.map(upd, self.state["slots"], param_tree,
+                     is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+
     def params(self):
         """Current params cast back to their training dtypes (host arrays)."""
         masters = jax.tree.map(
