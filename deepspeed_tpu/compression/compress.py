@@ -97,6 +97,12 @@ def redundancy_clean(model_or_params, deepspeed_config: Dict, mpu=None):
     if not lr_cfg.get("enabled", False):
         return params
     keep = lr_cfg.get("keep_layers")
+    import re
+    if isinstance(params.get("layers"), dict) and params["layers"] and \
+            all(re.fullmatch(r"g\d+", k) for k in params["layers"]):
+        raise NotImplementedError(
+            "layer reduction over heterogeneous (grouped) layer stacks is "
+            "ambiguous — reduce before grouping or use a homogeneous model")
     if keep is None:
         n = lr_cfg.get("keep_number_layer")
         total = jax.tree.leaves(params["layers"])[0].shape[0]

@@ -145,18 +145,28 @@ class Qwen2MoeContainer(Qwen2Container):
         "mlp.shared_gate": Param(
             "model.layers.{l}.mlp.shared_expert_gate.weight", t_linear),
     }
+    # dense interleave layers (mlp_only_layers / decoder_sparse_step) use the
+    # plain Qwen2 MLP names; routed layers use the expert mapping above
+    layer_mapping_by_type = {"dense": Qwen2Container.layer_mapping}
 
     @classmethod
     def config(cls, hf_cfg):
-        if getattr(hf_cfg, "mlp_only_layers", None) or \
-                int(_get(hf_cfg, "decoder_sparse_step", default=1)) != 1:
-            raise NotImplementedError(
-                "qwen2-moe with interleaved dense-MLP layers "
-                "(mlp_only_layers/decoder_sparse_step) is not scan-homogeneous")
+        n = hf_cfg.num_hidden_layers
+        step = int(_get(hf_cfg, "decoder_sparse_step", default=1))
+        only = set(getattr(hf_cfg, "mlp_only_layers", None) or [])
+        n_exp = int(_get(hf_cfg, "num_experts", default=8))
+        # HF Qwen2MoeDecoderLayer: layer l is sparse iff l not in
+        # mlp_only_layers and num_experts > 0 and (l+1) % decoder_sparse_step == 0
+        tags = tuple(
+            "moe" if (l not in only and n_exp > 0 and step > 0
+                      and (l + 1) % step == 0) else "dense"
+            for l in range(n))
         return _llama_family_config(
             hf_cfg, qkv_bias=True,
-            intermediate_size=int(hf_cfg.moe_intermediate_size),
-            num_experts=int(_get(hf_cfg, "num_experts", default=8)),
+            intermediate_size=int(hf_cfg.intermediate_size),
+            moe_intermediate_size=int(hf_cfg.moe_intermediate_size),
+            layer_types=None if all(t == "moe" for t in tags) else tags,
+            num_experts=n_exp,
             num_experts_per_tok=int(_get(hf_cfg, "num_experts_per_tok", default=2)),
             moe_norm_topk=bool(_get(hf_cfg, "norm_topk_prob", default=False)),
             moe_shared_expert_size=int(
@@ -358,10 +368,17 @@ class FalconContainer(LayerContainer):
     }
 
     @classmethod
-    def config(cls, hf_cfg):
+    def specialize(cls, hf_cfg):
         if getattr(hf_cfg, "new_decoder_architecture", False):
-            raise NotImplementedError(
-                "falcon new_decoder_architecture (40B+ grouped KV) not mapped yet")
+            n_ln = getattr(hf_cfg, "num_ln_in_parallel_attn", None)
+            if n_ln is None:
+                n_ln = 2   # HF defaults to 2 under new_decoder_architecture
+            return (FalconNewArchContainer if n_ln == 2
+                    else FalconNewArchSharedLnContainer)
+        return cls
+
+    @classmethod
+    def config(cls, hf_cfg):
         return TransformerConfig(
             vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
             num_layers=hf_cfg.num_hidden_layers,
@@ -375,6 +392,77 @@ class FalconContainer(LayerContainer):
             parallel_block=bool(_get(hf_cfg, "parallel_attn", default=True)),
             tie_embeddings=bool(_get(hf_cfg, "tie_word_embeddings", default=True)),
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+
+def _t_falcon_grouped(part):
+    """Falcon new_decoder_architecture fused QKV: rows are grouped per KV
+    head as [q_0..q_{hpg-1}, k, v] (HF ``FalconAttention._split_heads``)."""
+
+    def t(w, cfg):
+        kvh, h, d, e = cfg.kv_heads, cfg.num_heads, cfg.dims_per_head, cfg.hidden_size
+        hpg = h // kvh
+        w = w.reshape(kvh, hpg + 2, d, e)
+        if part == "q":
+            out = w[:, :hpg].reshape(h, d, e)
+        elif part == "k":
+            out = w[:, hpg]
+        else:
+            out = w[:, hpg + 1]
+        return out.transpose(2, 0, 1)
+
+    return t
+
+
+class FalconNewArchContainer(FalconContainer):
+    """Falcon-40B/180B (new_decoder_architecture): grouped-KV fused QKV and
+    TWO parallel-block norms — ln_attn feeds attention, ln_mlp feeds the MLP
+    (reference ``falcon/container.py`` maps the same split)."""
+
+    layer_mapping = {
+        "attn.wq": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_falcon_grouped("q")),
+        "attn.wk": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_falcon_grouped("k")),
+        "attn.wv": Param("transformer.h.{l}.self_attention.query_key_value.weight",
+                         _t_falcon_grouped("v")),
+        "attn.wo": Param("transformer.h.{l}.self_attention.dense.weight", t_o_heads),
+        "norm1.scale": Param("transformer.h.{l}.ln_attn.weight"),
+        "norm1.bias": Param("transformer.h.{l}.ln_attn.bias"),
+        "norm2.scale": Param("transformer.h.{l}.ln_mlp.weight"),
+        "norm2.bias": Param("transformer.h.{l}.ln_mlp.bias"),
+        "mlp.wi": Param("transformer.h.{l}.mlp.dense_h_to_4h.weight", t_linear),
+        "mlp.wo": Param("transformer.h.{l}.mlp.dense_4h_to_h.weight", t_linear),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        return TransformerConfig(
+            vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
+            num_layers=hf_cfg.num_hidden_layers,
+            num_heads=hf_cfg.num_attention_heads,
+            num_kv_heads=int(_get(hf_cfg, "num_kv_heads",
+                                  default=hf_cfg.num_attention_heads)),
+            intermediate_size=int(_get(hf_cfg, "ffn_hidden_size",
+                                       default=4 * hf_cfg.hidden_size)),
+            max_seq_len=_get(hf_cfg, "max_position_embeddings", default=2048),
+            activation="gelu_exact", norm="layernorm", position="rope",
+            rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
+            parallel_block=bool(_get(hf_cfg, "parallel_attn", default=True)),
+            tie_embeddings=bool(_get(hf_cfg, "tie_word_embeddings", default=True)),
+            norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
+
+
+class FalconNewArchSharedLnContainer(FalconNewArchContainer):
+    """new_decoder_architecture with num_ln_in_parallel_attn == 1: one
+    input_layernorm shared by both parallel branches."""
+
+    layer_mapping = {
+        **FalconNewArchContainer.layer_mapping,
+        "norm1.scale": Param("transformer.h.{l}.input_layernorm.weight"),
+        "norm1.bias": Param("transformer.h.{l}.input_layernorm.bias"),
+        "norm2.scale": Param("transformer.h.{l}.input_layernorm.weight"),
+        "norm2.bias": Param("transformer.h.{l}.input_layernorm.bias"),
+    }
 
 
 def _t_neox_qkv(idx):
@@ -492,6 +580,7 @@ class GPTJContainer(LayerContainer):
             rotary_pct=(_get(hf_cfg, "rotary_dim", default=d) or d) / d,
             rope_interleaved=True, parallel_block=True,
             use_bias=False, mlp_bias=True, tie_embeddings=False,
+            lm_head_bias=True,
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
 
 
@@ -520,14 +609,54 @@ class GemmaContainer(LlamaContainer):
 
     @classmethod
     def config(cls, hf_cfg):
-        if getattr(hf_cfg, "query_pre_attn_scalar", None) is not None:
-            raise NotImplementedError(
-                "gemma2 (pre+post norms, logit softcapping) not mapped")
         return _llama_family_config(
             hf_cfg, activation="geglu",
             head_dim=_get(hf_cfg, "head_dim"),
             embed_scale=float(hf_cfg.hidden_size) ** 0.5,
             tie_embeddings=True)
+
+
+class Gemma2Container(GemmaContainer):
+    """Gemma-2 (HF ``modeling_gemma2``): sandwich norms (input / post-attn /
+    pre-ffw / post-ffw, all offset-RMSNorm), attention-logit and final-logit
+    tanh softcapping, query_pre_attn_scalar attention scale, and sliding
+    window on the EVEN-indexed layers (HF layer_types alternation)."""
+
+    layer_mapping = {
+        **GemmaContainer.layer_mapping,
+        "norm1.scale": Param("model.layers.{l}.input_layernorm.weight",
+                             _t_rms_offset),
+        "norm3.scale": Param("model.layers.{l}.post_attention_layernorm.weight",
+                             _t_rms_offset),
+        "norm2.scale": Param("model.layers.{l}.pre_feedforward_layernorm.weight",
+                             _t_rms_offset),
+        "norm4.scale": Param("model.layers.{l}.post_feedforward_layernorm.weight",
+                             _t_rms_offset),
+    }
+
+    @classmethod
+    def config(cls, hf_cfg):
+        n = hf_cfg.num_hidden_layers
+        sw = int(_get(hf_cfg, "sliding_window", default=4096) or 0)
+        lt = list(getattr(hf_cfg, "layer_types", None) or
+                  ["sliding_attention" if (i + 1) % 2 else "full_attention"
+                   for i in range(n)])
+        pattern = tuple(sw if t == "sliding_attention" else 0 for t in lt)
+        if not sw or not any(pattern):
+            pattern = None
+        return _llama_family_config(
+            hf_cfg, activation="geglu",
+            head_dim=_get(hf_cfg, "head_dim"),
+            embed_scale=float(hf_cfg.hidden_size) ** 0.5,
+            tie_embeddings=True,
+            sandwich_norm=True,
+            window_pattern=pattern,
+            attn_scale=float(_get(hf_cfg, "query_pre_attn_scalar",
+                                  default=hf_cfg.head_dim)) ** -0.5,
+            attn_softcap=float(_get(hf_cfg, "attn_logit_softcapping", default=0.0)
+                               or 0.0),
+            logit_softcap=float(_get(hf_cfg, "final_logit_softcapping", default=0.0)
+                                or 0.0))
 
 
 def _t_mpt_qkv(idx):
@@ -556,6 +685,15 @@ class MptContainer(LayerContainer):
         "norm2.bias": Param("transformer.blocks.{l}.norm_2.bias", optional=True),
         "mlp.wi": Param("transformer.blocks.{l}.ffn.up_proj.weight", t_linear),
         "mlp.wo": Param("transformer.blocks.{l}.ffn.down_proj.weight", t_linear),
+        # qk_ln variant (full-width norms before the head split)
+        "attn.q_norm.scale": Param("transformer.blocks.{l}.attn.q_ln.weight",
+                                   optional=True),
+        "attn.q_norm.bias": Param("transformer.blocks.{l}.attn.q_ln.bias",
+                                  optional=True),
+        "attn.k_norm.scale": Param("transformer.blocks.{l}.attn.k_ln.weight",
+                                   optional=True),
+        "attn.k_norm.bias": Param("transformer.blocks.{l}.attn.k_ln.bias",
+                                  optional=True),
     }
     non_layer_mapping = {
         "embed.tok": Param("transformer.wte.weight"),
@@ -566,10 +704,12 @@ class MptContainer(LayerContainer):
     @classmethod
     def config(cls, hf_cfg):
         attn_cfg = getattr(hf_cfg, "attn_config", None)
-        if attn_cfg is not None and not getattr(attn_cfg, "alibi", True):
-            raise NotImplementedError("MPT without ALiBi (rope variants) not mapped")
-        if attn_cfg is not None and getattr(attn_cfg, "qk_ln", False):
-            raise NotImplementedError("MPT qk_ln variant not mapped")
+        ac = lambda k, d: getattr(attn_cfg, k, d) if attn_cfg is not None else d
+        alibi = ac("alibi", True)
+        rope = ac("rope", False)
+        if not alibi and not rope:
+            raise NotImplementedError(
+                "MPT with learned positions (alibi=False, rope=False) not mapped")
         if not getattr(hf_cfg, "no_bias", True):
             raise NotImplementedError(
                 "MPT no_bias=False checkpoints (biased Wqkv/out_proj/ffn) "
@@ -579,7 +719,12 @@ class MptContainer(LayerContainer):
             num_layers=hf_cfg.n_layers, num_heads=hf_cfg.n_heads,
             intermediate_size=int(hf_cfg.expansion_ratio * hf_cfg.d_model),
             max_seq_len=_get(hf_cfg, "max_seq_len", default=2048),
-            activation="gelu_exact", norm="layernorm", position="alibi",
+            activation="gelu_exact", norm="layernorm",
+            position="alibi" if alibi else "rope",
+            rope_theta=float(ac("rope_theta", 10000.0)),
+            # MPT qk_ln: LayerNorm(d_model) on q / (kvh*d) on k BEFORE the
+            # head split (modeling_mpt attn qk_ln) = our "full" layout
+            qk_norm="full" if ac("qk_ln", False) else None,
             use_bias=False, tie_embeddings=True,
             norm_eps=float(_get(hf_cfg, "layer_norm_epsilon", default=1e-5)))
 
@@ -591,6 +736,10 @@ class MptContainer(LayerContainer):
         for nm in ("norm1", "norm2"):
             grp = params["layers"][nm]
             if "bias" not in grp:
+                grp["bias"] = np.zeros_like(grp["scale"])
+        for nm in ("q_norm", "k_norm"):   # qk_ln under no_bias
+            grp = params["layers"]["attn"].get(nm)
+            if grp is not None and "bias" not in grp:
                 grp["bias"] = np.zeros_like(grp["scale"])
         if "bias" not in params["final_norm"]:
             params["final_norm"]["bias"] = np.zeros_like(params["final_norm"]["scale"])
@@ -619,6 +768,14 @@ class StableLmContainer(LayerContainer):
         "mlp.wi_gate": Param("model.layers.{l}.mlp.gate_proj.weight", t_linear),
         "mlp.wi_up": Param("model.layers.{l}.mlp.up_proj.weight", t_linear),
         "mlp.wo": Param("model.layers.{l}.mlp.down_proj.weight", t_linear),
+        # qk_layernorm variant: HF StableLmLayerNormPerHead is a ModuleList
+        # of bias-free LayerNorm(head_dim) — {h}/{g} stack them to (H, D)
+        "attn.q_norm.scale": Param(
+            "model.layers.{l}.self_attn.q_layernorm.norms.{h}.weight",
+            optional=True),
+        "attn.k_norm.scale": Param(
+            "model.layers.{l}.self_attn.k_layernorm.norms.{g}.weight",
+            optional=True),
     }
     non_layer_mapping = {
         "embed.tok": Param("model.embed_tokens.weight"),
@@ -628,11 +785,13 @@ class StableLmContainer(LayerContainer):
     }
 
     @classmethod
-    def config(cls, hf_cfg):
+    def specialize(cls, hf_cfg):
         if getattr(hf_cfg, "use_parallel_residual", False):
-            raise NotImplementedError("stablelm parallel-residual variant not mapped")
-        if getattr(hf_cfg, "qk_layernorm", False):
-            raise NotImplementedError("stablelm qk_layernorm variant not mapped")
+            return StableLmParallelContainer
+        return cls
+
+    @classmethod
+    def config(cls, hf_cfg):
         return TransformerConfig(
             vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
             num_layers=hf_cfg.num_hidden_layers,
@@ -644,8 +803,23 @@ class StableLmContainer(LayerContainer):
             rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
             rotary_pct=float(_get(hf_cfg, "partial_rotary_factor", default=0.25)),
             qkv_bias=bool(_get(hf_cfg, "use_qkv_bias", default=False)),
+            qk_norm="per_head" if getattr(hf_cfg, "qk_layernorm", False) else None,
+            qk_norm_bias=False,
+            parallel_block=bool(getattr(hf_cfg, "use_parallel_residual", False)),
             tie_embeddings=False,
             norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-5)))
+
+
+class StableLmParallelContainer(StableLmContainer):
+    """StableLM with use_parallel_residual: ONE shared input_layernorm feeds
+    both attention and MLP (HF StableLmDecoderLayer drops
+    post_attention_layernorm in this mode) — norm2 binds to the same tensor."""
+
+    layer_mapping = {
+        **StableLmContainer.layer_mapping,
+        "norm2.scale": Param("model.layers.{l}.input_layernorm.weight"),
+        "norm2.bias": Param("model.layers.{l}.input_layernorm.bias"),
+    }
 
 
 class BertContainer(LayerContainer):
@@ -776,6 +950,15 @@ class PhiContainer(LayerContainer):
         "mlp.bi": Param("model.layers.{l}.mlp.fc1.bias"),
         "mlp.wo": Param("model.layers.{l}.mlp.fc2.weight", t_linear),
         "mlp.bo": Param("model.layers.{l}.mlp.fc2.bias"),
+        # qk_layernorm variant: one LayerNorm(head_dim) SHARED by all heads
+        "attn.q_norm.scale": Param("model.layers.{l}.self_attn.q_layernorm.weight",
+                                   optional=True),
+        "attn.q_norm.bias": Param("model.layers.{l}.self_attn.q_layernorm.bias",
+                                  optional=True),
+        "attn.k_norm.scale": Param("model.layers.{l}.self_attn.k_layernorm.weight",
+                                   optional=True),
+        "attn.k_norm.bias": Param("model.layers.{l}.self_attn.k_layernorm.bias",
+                                  optional=True),
     }
     non_layer_mapping = {
         "embed.tok": Param("model.embed_tokens.weight"),
@@ -787,8 +970,6 @@ class PhiContainer(LayerContainer):
 
     @classmethod
     def config(cls, hf_cfg):
-        if getattr(hf_cfg, "qk_layernorm", False):
-            raise NotImplementedError("phi qk_layernorm variant not mapped")
         return TransformerConfig(
             vocab_size=hf_cfg.vocab_size, hidden_size=hf_cfg.hidden_size,
             num_layers=hf_cfg.num_hidden_layers,
@@ -799,7 +980,9 @@ class PhiContainer(LayerContainer):
             activation="gelu", norm="layernorm", position="rope",
             rope_theta=float(_get(hf_cfg, "rope_theta", default=10000.0)),
             rotary_pct=float(_get(hf_cfg, "partial_rotary_factor", default=0.5)),
+            qk_norm="head_dim" if getattr(hf_cfg, "qk_layernorm", False) else None,
             parallel_block=True, use_bias=True, tie_embeddings=False,
+            lm_head_bias=True,
             norm_eps=float(_get(hf_cfg, "layer_norm_eps", default=1e-5)))
 
 
@@ -924,6 +1107,7 @@ ARCH_CONTAINERS: Dict[str, Type[LayerContainer]] = {
     "distilbert": DistilBertContainer,
     "bert": BertContainer,
     "bloom": BloomContainer,
+    "gemma2": Gemma2Container,
     "gemma": GemmaContainer,
     "mpt": MptContainer,
     "stablelm": StableLmContainer,
@@ -995,7 +1179,7 @@ def resolve_container(hf_cfg) -> Type[LayerContainer]:
     # capture e.g. RoBERTa under "bert"
     for key in sorted(ARCH_CONTAINERS, key=len, reverse=True):
         if arch.replace("_", "").startswith(key):
-            return ARCH_CONTAINERS[key]
+            return ARCH_CONTAINERS[key].specialize(hf_cfg)
     if _looks_llama_shaped(hf_cfg):
         from ....utils.logging import logger
         logger.warning(

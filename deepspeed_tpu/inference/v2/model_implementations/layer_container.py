@@ -60,9 +60,11 @@ def t_identity(w, cfg):
 class Param:
     """One native slot: source name template(s) + transform.
 
-    ``src`` templates may use ``{l}`` (layer index) and ``{x}`` (expert
-    index; presence marks an expert-stacked parameter). Multiple sources are
-    passed to the transform as a list (fused-weight splitting).
+    ``src`` templates may use ``{l}`` (layer index), ``{x}`` (expert index;
+    presence marks an expert-stacked parameter), or ``{h}``/``{g}``
+    (query/kv head index — stacks per-head tensors like StableLM's
+    per-head q/k layernorm weights). Multiple sources are passed to the
+    transform as a list (fused-weight splitting).
     """
 
     def __init__(self, src: Union[str, Sequence[str]],
@@ -72,24 +74,30 @@ class Param:
         self.optional = optional
 
     def materialize(self, sd, cfg, l: int, num_experts: int = 0):
-        def one(fmt, x=None):
-            name = fmt.format(l=l, x=x)
+        def one(fmt, **kw):
+            name = fmt.format(l=l, **{k: kw.get(k) for k in ("x", "h", "g")})
             if name not in sd:
                 if self.optional:
                     return None
                 raise KeyError(f"checkpoint missing tensor {name!r}")
             return _np(sd[name])
 
-        expert_stacked = any("{x}" in s for s in self.srcs)
-        if expert_stacked:
-            per_expert = []
-            for x in range(num_experts):
-                vals = [one(s, x) for s in self.srcs]
+        def stacked(count, key):
+            per = []
+            for i in range(count):
+                vals = [one(s, **{key: i}) for s in self.srcs]
                 if any(v is None for v in vals):
                     return None
                 v = vals[0] if len(vals) == 1 else vals
-                per_expert.append(self.transform(v, cfg))
-            return np.stack(per_expert)
+                per.append(self.transform(v, cfg))
+            return np.stack(per)
+
+        if any("{x}" in s for s in self.srcs):
+            return stacked(num_experts, "x")
+        if any("{h}" in s for s in self.srcs):
+            return stacked(cfg.num_heads, "h")
+        if any("{g}" in s for s in self.srcs):
+            return stacked(cfg.kv_heads, "g")
         vals = [one(s) for s in self.srcs]
         if any(v is None for v in vals):
             return None
@@ -107,6 +115,10 @@ class LayerContainer:
     """
 
     layer_mapping: Dict[str, Param] = {}
+    # per-layer-type mappings for heterogeneous stacks (Qwen2-MoE's
+    # interleaved dense layers use different source names than its routed
+    # layers); tags missing here fall back to ``layer_mapping``
+    layer_mapping_by_type: Dict[str, Dict[str, Param]] = {}
     non_layer_mapping: Dict[str, Param] = {}
     model_class = None   # resolved lazily to CausalLM; containers may override
 
@@ -120,6 +132,14 @@ class LayerContainer:
     def config(cls, hf_cfg) -> TransformerConfig:
         raise NotImplementedError
 
+    @classmethod
+    def specialize(cls, hf_cfg) -> type:
+        """Hook for architectures whose checkpoint LAYOUT (not just config)
+        depends on HF config flags — e.g. Falcon's new_decoder_architecture
+        grouped-QKV, StableLM's parallel-residual shared norm. Returns the
+        container class to actually use; default: this one."""
+        return cls
+
     @staticmethod
     def _set(tree, dotted: str, value):
         parts = dotted.split(".")
@@ -128,20 +148,46 @@ class LayerContainer:
         tree[parts[-1]] = value
 
     @classmethod
-    def build_params(cls, sd, cfg: TransformerConfig):
-        """Walk the mapping for every layer, stack to (L, ...) trees."""
-        per_layer: Dict[str, List[np.ndarray]] = {k: [] for k in cls.layer_mapping}
-        for l in range(cfg.num_layers):
-            for path, param in cls.layer_mapping.items():
+    def _mapping_for(cls, tag: str) -> Dict[str, Param]:
+        return cls.layer_mapping_by_type.get(tag, cls.layer_mapping)
+
+    @classmethod
+    def _build_group(cls, sd, cfg, layer_indices, tag):
+        mapping = cls._mapping_for(tag)
+        per_layer: Dict[str, List[np.ndarray]] = {k: [] for k in mapping}
+        for l in layer_indices:
+            for path, param in mapping.items():
                 v = param.materialize(sd, cfg, l, cfg.num_experts)
                 if v is not None:
                     per_layer[path].append(v)
-        layers: Dict = {}
+        group: Dict = {}
         for path, vals in per_layer.items():
             if vals:
-                cls._set(layers, path, np.stack(vals))
+                cls._set(group, path, np.stack(vals))
+        return group
+
+    @classmethod
+    def build_params(cls, sd, cfg: TransformerConfig):
+        """Walk the mapping for every layer, stack to (L, ...) trees.
+
+        Heterogeneous stacks (cfg.layer_types) are laid out per param group
+        exactly as the model's ``layer_groups`` plan — g{i} stacked over that
+        group's layer indices."""
+        from ....models.transformer import layer_groups
+        groups = layer_groups(cfg)
+        if groups is None:
+            layers = cls._build_group(sd, cfg, range(cfg.num_layers),
+                                      cfg.layer_type(0))
+        else:
+            layers = {f"g{gi}": cls._build_group(sd, cfg, idxs, tag)
+                      for gi, (tag, idxs) in enumerate(groups)}
         out: Dict = {"layers": layers}
         for path, param in cls.non_layer_mapping.items():
+            if cfg.tie_embeddings and path in ("embed.lm_head",
+                                               "embed.lm_head_bias"):
+                # HF state_dicts expose tied heads under both names; the
+                # native tied model has no separate lm_head leaf
+                continue
             v = param.materialize(sd, cfg, 0, cfg.num_experts)
             if v is not None:
                 cls._set(out, path, v)

@@ -39,6 +39,11 @@ class PagedModelRunner:
                 "the paged serving runner executes causal pre-norm decoder "
                 "blocks; BERT-style encoders are not autoregressive — serve "
                 "them with InferenceEngine (v1) forward passes")
+        if model._groups is not None:
+            raise NotImplementedError(
+                "heterogeneous layer stacks (cfg.layer_types) are not yet "
+                "threaded through the paged runner's layer scan; serve via "
+                "InferenceEngine (v1) generate")
         self.model = model
         self.cfg = model.cfg
         self.block_size = block_size
@@ -101,6 +106,9 @@ class PagedModelRunner:
                 q = q + lp["attn"]["bq"].astype(dt)
                 k = k + lp["attn"]["bk"].astype(dt)
                 v = v + lp["attn"]["bv"].astype(dt)
+            if cfg.qk_norm:
+                q = L.apply_qk_norm(lp["attn"]["q_norm"], q, cfg)
+                k = L.apply_qk_norm(lp["attn"]["k_norm"], k, cfg)
             if cfg.position == "rope":
                 q = L.apply_rope(q, pos_safe, inv_freq,
                                  interleaved=cfg.rope_interleaved)
@@ -109,12 +117,13 @@ class PagedModelRunner:
             kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
             vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
             if (c == 1 and _use_pallas_paged() and cfg.position != "alibi"
-                    and win is None):
+                    and win is None and not cfg.attn_softcap):
                 # decode: Pallas kernel reads pages in place (no gather)
                 from ...ops.pallas.paged_attention import paged_decode_attention
                 out = paged_decode_attention(
                     q[:, 0], kp, vp, block_tables,
-                    seq_lens=jnp.maximum(positions[:, 0] + 1, 0))[:, None]
+                    seq_lens=jnp.maximum(positions[:, 0] + 1, 0),
+                    scale=cfg.attn_scale)[:, None]
             else:
                 kpages = kp[:, block_tables].reshape(
                     cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
@@ -127,6 +136,8 @@ class PagedModelRunner:
             y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
             if "bo" in lp["attn"]:   # presence-keyed: out_bias may differ from use_bias
                 y = y + lp["attn"]["bo"].astype(dt)
+            if cfg.sandwich_norm:   # Gemma-2 post-attn output norm
+                y = L.apply_norm(lp["norm3"], y, cfg)
             if cfg.parallel_block:   # NeoX/Falcon: attn and mlp share input
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
             else:
@@ -136,6 +147,8 @@ class PagedModelRunner:
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+            if cfg.sandwich_norm:
+                mlp_out = L.apply_norm(lp["norm4"], mlp_out, cfg)
             if cfg.parallel_block:
                 return h + y + mlp_out, (kp, vp)
             return h + mlp_out, (kp, vp)
@@ -152,6 +165,8 @@ class PagedModelRunner:
             logits = jnp.einsum("be,ev->bv", h_last, params["embed"]["lm_head"].astype(dt))
         if "lm_head_bias" in params["embed"]:
             logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
+        if cfg.logit_softcap:
+            logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
         return logits.astype(jnp.float32), kpool, vpool
 
     def _build_decode_loop(self):
@@ -213,8 +228,11 @@ def _paged_attention(q, kpages, vpages, positions, cfg, window=None):
         kpages = jnp.repeat(kpages, rep, axis=2)
         vpages = jnp.repeat(vpages, rep, axis=2)
     d = q.shape[-1]
+    scale = cfg.attn_scale if cfg.attn_scale is not None else d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kpages,
-                        preferred_element_type=jnp.float32) * (d ** -0.5)
+                        preferred_element_type=jnp.float32) * scale
+    if cfg.attn_softcap:
+        logits = cfg.attn_softcap * jnp.tanh(logits / cfg.attn_softcap)
     if cfg.position == "alibi":
         # gathered page slot index IS the absolute sequence position
         logits = logits + L.alibi_bias(

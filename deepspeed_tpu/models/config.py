@@ -40,6 +40,7 @@ class TransformerConfig:
     type_vocab_size: int = 0            # token-type (segment) embeddings (BERT)
     mlm_head: bool = False              # BERT MLM head: dense+gelu+LN+decoder bias
     tie_embeddings: bool = False
+    lm_head_bias: bool = False          # biased untied LM head (GPT-J, Phi)
     use_bias: bool = False
     qkv_bias: bool = False              # bias on q/k/v only (Qwen2)
     mlp_bias: Optional[bool] = None     # None → use_bias (GPT-J: mlp-only biases)
@@ -52,6 +53,31 @@ class TransformerConfig:
     # ALL layers are windowed.
     sliding_window: Optional[int] = None
     local_attention_every: Optional[int] = None
+    # explicit per-layer window sizes (len == num_layers, 0 = global) for
+    # patterns local_attention_every can't express (Gemma-2 windows the
+    # EVEN-indexed layers). Takes precedence over local_attention_every.
+    window_pattern: Optional[tuple] = None
+    # q/k normalization before rope (HF refs: MPT attn_config.qk_ln,
+    # StableLM qk_layernorm, Phi qk_layernorm):
+    #   "full":     one norm over the flattened (H*D) q / (KVH*D) k vectors
+    #   "head_dim": one (D,) norm shared by all heads
+    #   "per_head": separate (H, D) weights per head
+    # The norm family follows cfg.norm (all current variants: layernorm).
+    qk_norm: Optional[str] = None
+    qk_norm_bias: bool = True           # StableLM's per-head LNs are bias-free
+    # Gemma-2 block structure: extra norms on each sublayer OUTPUT before
+    # the residual add (norm1=input, norm3=post-attn, norm2=pre-ffw,
+    # norm4=post-ffw)
+    sandwich_norm: bool = False
+    attn_softcap: float = 0.0           # tanh softcap on attention logits (Gemma-2)
+    logit_softcap: float = 0.0          # tanh softcap on final LM logits (Gemma-2)
+    attn_scale: Optional[float] = None  # override 1/sqrt(head_dim) (Gemma-2
+                                        # query_pre_attn_scalar ** -0.5)
+    # per-layer structure tags for heterogeneous stacks ("dense" | "moe";
+    # len == num_layers). None = homogeneous (every layer is MoE iff
+    # num_experts > 0). Qwen2-MoE's mlp_only_layers / decoder_sparse_step
+    # interleave dense-MLP layers into a routed-expert stack.
+    layer_types: Optional[tuple] = None
     # MoE (Mixtral-style; 0 experts → dense)
     num_experts: int = 0
     num_experts_per_tok: int = 2
@@ -63,6 +89,9 @@ class TransformerConfig:
     # "grouped": dropless sort-by-expert + ragged_dot (megablox pattern,
     # expert axis unsharded only)
     moe_impl: str = "einsum"
+    # routed-expert FFN width when it differs from the dense-MLP width
+    # (Qwen2-MoE: moe_intermediate_size vs intermediate_size); None → ffn_size
+    moe_intermediate_size: Optional[int] = None
     # numerics
     dtype: str = "bfloat16"             # activation dtype
     param_dtype: str = "float32"        # stored parameter dtype
@@ -102,8 +131,17 @@ class TransformerConfig:
         return jnp.dtype(self.param_dtype)
 
     @property
+    def moe_ffn_size(self) -> int:
+        return self.moe_intermediate_size or self.ffn_size
+
+    @property
     def is_moe(self) -> bool:
         return self.num_experts > 0
+
+    def layer_type(self, i: int) -> str:
+        if self.layer_types is not None:
+            return self.layer_types[i]
+        return "moe" if self.is_moe else "dense"
 
     def replace(self, **kw):
         return dataclasses.replace(self, **kw)

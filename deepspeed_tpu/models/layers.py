@@ -151,7 +151,47 @@ def init_attention(rng, cfg: TransformerConfig):
     if out_bias:
         params.update(bo=_zeros((e,), cfg.p_dtype))
         axes.update(bo=("embed",))
+    if cfg.qk_norm:
+        q_shape, k_shape = {
+            "full": ((h * d,), (kvh * d,)),
+            "head_dim": ((d,), (d,)),
+            "per_head": ((h, d), (kvh, d)),
+        }[cfg.qk_norm]
+        for nm, shape in (("q_norm", q_shape), ("k_norm", k_shape)):
+            grp = {"scale": _ones(shape, cfg.p_dtype)}
+            grp_axes = {"scale": tuple("unmodeled" for _ in shape)}
+            if cfg.norm == "layernorm" and cfg.qk_norm_bias:
+                grp["bias"] = _zeros(shape, cfg.p_dtype)
+                grp_axes["bias"] = grp_axes["scale"]
+            params[nm] = grp
+            axes[nm] = grp_axes
     return params, axes
+
+
+def apply_qk_norm(norm_params, x, cfg: TransformerConfig):
+    """Normalize q or k heads: x (B, S, H, D).
+
+    "full" normalizes the flattened per-token (H*D) vector (MPT qk_ln:
+    LayerNorm(d_model) before the head split); "head_dim"/"per_head"
+    normalize each head's D dims (Phi shares one (D,) weight, StableLM
+    stacks (H, D)) — the stats are per-head either way, only the weight
+    sharing differs, and both weight shapes broadcast over (B, S, H, D).
+    """
+    b, s, h, d = x.shape
+    x32 = x.astype(jnp.float32)
+    if cfg.qk_norm == "full":
+        x32 = x32.reshape(b, s, h * d)
+    if cfg.norm == "rmsnorm":
+        var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+        y = x32 * jax.lax.rsqrt(var + cfg.norm_eps)
+    else:
+        mean = jnp.mean(x32, axis=-1, keepdims=True)
+        var = jnp.var(x32, axis=-1, keepdims=True)
+        y = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
+    y = y * norm_params["scale"].astype(jnp.float32)
+    if "bias" in norm_params:
+        y = y + norm_params["bias"].astype(jnp.float32)
+    return y.reshape(b, s, h, d).astype(x.dtype)
 
 
 def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_freq=None,
@@ -177,6 +217,9 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
         q = q + params["bq"].astype(dt)
         k = k + params["bk"].astype(dt)
         v = v + params["bv"].astype(dt)
+    if cfg.qk_norm:
+        q = apply_qk_norm(params["q_norm"], q, cfg)
+        k = apply_qk_norm(params["k_norm"], k, cfg)
     if cfg.position == "rope":
         if positions is None:
             positions = jnp.broadcast_to(jnp.arange(x.shape[1]), x.shape[:2])
@@ -196,7 +239,8 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
         if cfg.position == "alibi" and bias is None:
             k_pos = jnp.arange(ck.shape[1])
             bias = alibi_bias(cfg.num_heads, idx, k_pos)   # (B, H, S, S_max)
-        out = decode_attention(q, ck, cv, cache_len + s, bias=bias, window=window)
+        out = decode_attention(q, ck, cv, cache_len + s, bias=bias, window=window,
+                               scale=cfg.attn_scale, softcap=cfg.attn_softcap)
     else:
         impl = None if cfg.attn_impl == "auto" else cfg.attn_impl
         slopes = None
@@ -206,7 +250,8 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
             slopes = alibi_slopes(cfg.num_heads)
         out = multihead_attention(q, k, v, causal=cfg.causal, segment_ids=segment_ids,
                                   bias=attn_bias, alibi_slopes=slopes,
-                                  window=window, impl=impl)
+                                  window=window, impl=impl, scale=cfg.attn_scale,
+                                  softcap=cfg.attn_softcap)
 
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if "bo" in params:
@@ -275,7 +320,7 @@ def apply_mlp(params, x, cfg: TransformerConfig):
 def init_moe_mlp(rng, cfg: TransformerConfig):
     """Mixtral-style top-k routed experts with swiglu experts (+ optional
     Qwen2-MoE always-on shared expert with its own sigmoid gate)."""
-    e, f, x = cfg.hidden_size, cfg.ffn_size, cfg.num_experts
+    e, f, x = cfg.hidden_size, cfg.moe_ffn_size, cfg.num_experts
     r = jax.random.split(rng, 8)
     std = 0.02
     params = {
@@ -437,4 +482,7 @@ def init_embeddings(rng, cfg: TransformerConfig):
         params["lm_head"] = _normal(r[2], (cfg.hidden_size, cfg.vocab_size), cfg.p_dtype,
                                     cfg.hidden_size ** -0.5)
         axes["lm_head"] = ("embed", "vocab")
+        if cfg.lm_head_bias:
+            params["lm_head_bias"] = _zeros((cfg.vocab_size,), cfg.p_dtype)
+            axes["lm_head_bias"] = ("vocab",)
     return params, axes

@@ -83,12 +83,62 @@ def _remat_policy(name: str):
     return None
 
 
-def lm_head_logits(h, w, transpose, dt, bias=None):
-    """logits = h @ (w if transpose else w.T) (+ bias): (B, S, E) → (B, S, V)."""
+def layer_plan(cfg):
+    """Execution plan for the layer stack (None = homogeneous single scan).
+
+    Heterogeneous stacks (cfg.layer_types, e.g. Qwen2-MoE's interleaved
+    dense-MLP layers — reference ``model_implementations/qwen_v2_moe``) are
+    compiled as:
+      ("periodic", p) — tags repeat with period p (decoder_sparse_step):
+        ONE scan over L/p super-layers whose body applies p sublayers; still
+        one compiled body regardless of depth.
+      ("segments", [(tag, start, length), ...]) — contiguous runs
+        (mlp_only_layers prefixes): one scan per run.
+    """
+    tags = cfg.layer_types
+    if tags is None or len(set(tags)) <= 1:
+        return None
+    n = len(tags)
+    # a period must leave >= 2 scan steps (p == n is the fully-unrolled
+    # degenerate "period"; contiguous runs handle those stacks better)
+    for p in range(2, min(8, n // 2) + 1):
+        if n % p == 0 and all(tags[i] == tags[i % p] for i in range(n)):
+            return ("periodic", p)
+    runs = []
+    start = 0
+    for i in range(1, n + 1):
+        if i == n or tags[i] != tags[start]:
+            runs.append((tags[start], start, i - start))
+            start = i
+    return ("segments", runs)
+
+
+def layer_groups(cfg):
+    """None (homogeneous) or the ordered param groups of the plan:
+    [(tag, (layer indices...)), ...] — group i becomes params["layers"]["g{i}"]
+    stacked over its indices. Shared by the model and the HF checkpoint
+    containers so both lay out the same tree."""
+    plan = layer_plan(cfg)
+    if plan is None:
+        return None
+    if plan[0] == "periodic":
+        p = plan[1]
+        return [(cfg.layer_types[i], tuple(range(i, cfg.num_layers, p)))
+                for i in range(p)]
+    return [(tag, tuple(range(start, start + ln)))
+            for tag, start, ln in plan[1]]
+
+
+def lm_head_logits(h, w, transpose, dt, bias=None, softcap=0.0):
+    """logits = h @ (w if transpose else w.T) (+ bias): (B, S, E) → (B, S, V).
+
+    ``softcap``: Gemma-2 final_logit_softcapping (cap * tanh(logits/cap))."""
     eq = "bse,ev->bsv" if transpose else "bse,ve->bsv"
     logits = jnp.einsum(eq, h, w.astype(dt))
     if bias is not None:
         logits = logits + bias.astype(logits.dtype)
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
     return logits
 
 
@@ -116,14 +166,16 @@ class CausalLM:
     def __init__(self, cfg: TransformerConfig):
         self.cfg = cfg
         self._inv_freq = L.rope_frequencies(cfg) if cfg.position == "rope" else None
+        self._plan = layer_plan(cfg)
+        self._groups = layer_groups(cfg)
 
     # -- init --
 
-    def _init_layer(self, rng):
+    def _init_layer(self, rng, layer_type=None):
         cfg = self.cfg
         r_attn, r_mlp = jax.random.split(rng)
         attn, attn_axes = L.init_attention(r_attn, cfg)
-        if cfg.is_moe:
+        if (cfg.is_moe if layer_type is None else layer_type == "moe"):
             mlp, mlp_axes = L.init_moe_mlp(r_mlp, cfg)
         else:
             mlp, mlp_axes = L.init_mlp(r_mlp, cfg)
@@ -131,6 +183,9 @@ class CausalLM:
         norm2, norm2_axes = L.init_norm(cfg)
         params = {"attn": attn, "mlp": mlp, "norm1": norm1, "norm2": norm2}
         axes = {"attn": attn_axes, "mlp": mlp_axes, "norm1": norm1_axes, "norm2": norm2_axes}
+        if cfg.sandwich_norm:   # Gemma-2 post-attn / post-ffw output norms
+            for nm in ("norm3", "norm4"):
+                params[nm], axes[nm] = L.init_norm(cfg)
         return params, axes
 
     def init(self, rng):
@@ -138,8 +193,14 @@ class CausalLM:
         r_emb, r_layers = jax.random.split(rng)
         emb, _ = L.init_embeddings(r_emb, cfg)
         layer_rngs = jax.random.split(r_layers, cfg.num_layers)
-        per_layer = [self._init_layer(r)[0] for r in layer_rngs]
-        stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+        if self._groups is None:
+            per_layer = [self._init_layer(r)[0] for r in layer_rngs]
+            stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *per_layer)
+        else:
+            stacked = {}
+            for gi, (tag, idxs) in enumerate(self._groups):
+                per = [self._init_layer(layer_rngs[i], tag)[0] for i in idxs]
+                stacked[f"g{gi}"] = jax.tree.map(lambda *xs: jnp.stack(xs), *per)
         out = {"embed": emb, "layers": stacked}
         if not cfg.post_norm:   # post-norm (BERT) normalizes inside each layer
             out["final_norm"] = L.init_norm(cfg)[0]
@@ -154,8 +215,17 @@ class CausalLM:
         layer params get a leading "layers" axis."""
         cfg = self.cfg
         emb_axes = _axes_of(lambda r: L.init_embeddings(r, cfg))
-        layer_axes = _axes_of(self._init_layer)
-        stacked_axes = jax.tree.map(lambda a: ("layers",) + a, layer_axes, is_leaf=_is_axes_leaf)
+
+        def stack_axes(tag=None):
+            layer_axes = _axes_of(lambda r: self._init_layer(r, tag))
+            return jax.tree.map(lambda a: ("layers",) + a, layer_axes,
+                                is_leaf=_is_axes_leaf)
+
+        if self._groups is None:
+            stacked_axes = stack_axes()
+        else:
+            stacked_axes = {f"g{gi}": stack_axes(tag)
+                            for gi, (tag, _) in enumerate(self._groups)}
         out = {"embed": emb_axes, "layers": stacked_axes}
         if not cfg.post_norm:
             out["final_norm"] = _axes_of(lambda r: L.init_norm(cfg))
@@ -164,18 +234,24 @@ class CausalLM:
     # -- forward --
 
     def _layer_windows(self):
-        """(L,)-int32 per-layer window array for alternating local/global
-        patterns (GPT-Neo), or None when layers are homogeneous (uniform
-        windows flow through cfg.sliding_window inside apply_attention)."""
+        """(L,)-int32 per-layer window array for mixed local/global patterns
+        (GPT-Neo alternation via ``local_attention_every``, Gemma-2's
+        even-layers-windowed via an explicit ``window_pattern``), or None
+        when layers are homogeneous (uniform windows flow through
+        cfg.sliding_window inside apply_attention)."""
         cfg = self.cfg
+        if cfg.window_pattern is not None:
+            return jnp.asarray(cfg.window_pattern, jnp.int32)
         if cfg.sliding_window is None or not cfg.local_attention_every:
             return None
         n = cfg.local_attention_every
         return jnp.asarray([cfg.sliding_window if i % n == n - 1 else 0
                             for i in range(cfg.num_layers)], jnp.int32)
 
-    def _layer_fn(self, lp, h, positions, segment_ids, attn_bias=None, window=None):
+    def _layer_fn(self, lp, h, positions, segment_ids, attn_bias=None, window=None,
+                  layer_type=None):
         cfg = self.cfg
+        is_moe = cfg.is_moe if layer_type is None else layer_type == "moe"
         if cfg.post_norm:
             # BERT block: norm AFTER each residual add, attention reads the
             # raw stream
@@ -190,6 +266,8 @@ class CausalLM:
         attn_out, _ = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                         inv_freq=self._inv_freq, segment_ids=segment_ids,
                                         attn_bias=attn_bias, window=window)
+        if cfg.sandwich_norm:   # Gemma-2: norm the sublayer OUTPUT pre-residual
+            attn_out = L.apply_norm(lp["norm3"], attn_out, cfg)
         if cfg.parallel_block:
             # NeoX/Falcon parallel residual: attn and mlp both read the
             # pre-attention stream; one residual add
@@ -197,10 +275,12 @@ class CausalLM:
         else:
             h = h + attn_out
             m_in = L.apply_norm(lp["norm2"], h, cfg)
-        if cfg.is_moe:
+        if is_moe:
             mlp_out, aux = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
         else:
             mlp_out, aux = L.apply_mlp(lp["mlp"], m_in, cfg), jnp.zeros((), jnp.float32)
+        if cfg.sandwich_norm:
+            mlp_out = L.apply_norm(lp["norm4"], mlp_out, cfg)
         if cfg.parallel_block:
             return h + attn_out + mlp_out, aux
         return h + mlp_out, aux
@@ -240,8 +320,10 @@ class CausalLM:
                 and logit_buffer_bytes(labels.size, cfg) > cfg.loss_chunk_threshold_bytes):
             from ..ops.cross_entropy import lm_cross_entropy
             return lm_cross_entropy(h, w.astype(h.dtype), labels, loss_mask=loss_mask,
-                                    n_chunks=cfg.loss_chunks, transpose_w=transpose)
-        logits = lm_head_logits(h, w, transpose, cfg.act_dtype)
+                                    n_chunks=cfg.loss_chunks, transpose_w=transpose,
+                                    softcap=cfg.logit_softcap)
+        logits = lm_head_logits(h, w, transpose, cfg.act_dtype,
+                                softcap=cfg.logit_softcap)
         return masked_token_nll(logits, labels, loss_mask)
 
     def hidden_states(self, params, input_ids, *, positions=None, segment_ids=None,
@@ -262,21 +344,58 @@ class CausalLM:
         attn_bias = None
 
         windows = self._layer_windows()
+        carry = (h, jnp.zeros((), jnp.float32))
 
-        def body(carry, xs):
-            lp, win = xs
-            h, aux_sum = carry
-            h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias, win)
-            return (constrain(h), aux_sum + aux), None
+        def make_body(fn):
+            return (jax.checkpoint(fn, policy=_remat_policy(cfg.remat))
+                    if cfg.remat != "none" else fn)
 
-        if cfg.remat != "none":
-            body = jax.checkpoint(body, policy=_remat_policy(cfg.remat))
+        def run_scan(stacked, win_slice, tag, carry):
+            def body(carry, xs):
+                lp, win = xs
+                h, aux_sum = carry
+                h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias,
+                                        win, layer_type=tag)
+                return (constrain(h), aux_sum + aux), None
 
-        (h, aux_total), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
-                                         (params["layers"], windows))
+            carry, _ = jax.lax.scan(make_body(body), carry, (stacked, win_slice))
+            return carry
+
+        if self._groups is None:
+            carry = run_scan(params["layers"], windows, None, carry)
+        elif self._plan[0] == "periodic":
+            # one scan over L/p super-layers; the body applies the p
+            # per-position sublayers in order (layer t*p+j is group j step t)
+            p = self._plan[1]
+            win_rs = None if windows is None else windows.reshape(-1, p)
+
+            def body(carry, xs):
+                groups_t, win_t = xs
+                h, aux_sum = carry
+                for j, (tag, _) in enumerate(self._groups):
+                    w_j = None if win_t is None else win_t[j]
+                    h, aux = self._layer_fn(groups_t[f"g{j}"], h, positions,
+                                            segment_ids, attn_bias, w_j,
+                                            layer_type=tag)
+                    aux_sum = aux_sum + aux
+                return (constrain(h), aux_sum), None
+
+            carry, _ = jax.lax.scan(make_body(body), carry,
+                                    (params["layers"], win_rs))
+        else:   # contiguous segments: one scan per run
+            for gi, (tag, idxs) in enumerate(self._groups):
+                w_seg = None if windows is None else \
+                    windows[idxs[0]:idxs[0] + len(idxs)]
+                carry = run_scan(params["layers"][f"g{gi}"], w_seg, tag, carry)
+
+        h, aux_total = carry
         if not cfg.post_norm:
             h = L.apply_norm(params["final_norm"], h, cfg)
-        return h, aux_total / cfg.num_layers
+        # average the load-balancing aux over layers that HAVE routers
+        # (dense interleave layers contribute 0 and must not dilute it)
+        n_moe = sum(1 for i in range(cfg.num_layers)
+                    if cfg.layer_type(i) == "moe") or 1
+        return h, aux_total / n_moe
 
     def _lm_head_weight(self, params):
         """Returns (w, transpose): logits = h @ (w.T if not transpose else w)."""
@@ -291,12 +410,9 @@ class CausalLM:
         h, aux_total = self.hidden_states(params, input_ids, positions=positions,
                                           segment_ids=segment_ids)
         w, transpose = self._lm_head_weight(params)
-        if transpose:
-            logits = jnp.einsum("bse,ev->bsv", h, w.astype(dt))
-        else:
-            logits = jnp.einsum("bse,ve->bsv", h, w.astype(dt))
-        if "lm_head_bias" in params["embed"]:   # GPT-J style biased head
-            logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
+        logits = lm_head_logits(h, w, transpose, dt,
+                                bias=params["embed"].get("lm_head_bias"),
+                                softcap=self.cfg.logit_softcap)
         if return_aux_loss:
             return logits, aux_total
         return logits
@@ -329,35 +445,80 @@ class CausalLM:
 
         windows = self._layer_windows()
 
-        def body(h, layer_in):
-            lp, ck, cv, win = layer_in
+        def dec_layer(lp, h, ck, cv, win, tag=None):
+            is_moe = cfg.is_moe if tag is None else tag == "moe"
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                              inv_freq=self._inv_freq,
                                              kv_cache=(ck, cv), cache_len=cache_len,
                                              attn_bias=attn_bias, window=win)
+            if cfg.sandwich_norm:
+                attn_out = L.apply_norm(lp["norm3"], attn_out, cfg)
             if cfg.parallel_block:
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
             else:
                 h = h + attn_out
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
-            if cfg.is_moe:
+            if is_moe:
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+            if cfg.sandwich_norm:
+                mlp_out = L.apply_norm(lp["norm4"], mlp_out, cfg)
             if cfg.parallel_block:
                 return h + attn_out + mlp_out, kv
             return h + mlp_out, kv
 
-        h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"],
-                                                   cache["v"], windows))
+        if self._groups is None:
+            def body(h, layer_in):
+                lp, ck, cv, win = layer_in
+                return dec_layer(lp, h, ck, cv, win)
+
+            h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"],
+                                                       cache["v"], windows))
+        elif self._plan[0] == "periodic":
+            p = self._plan[1]
+            ck_rs = cache["k"].reshape((-1, p) + cache["k"].shape[1:])
+            cv_rs = cache["v"].reshape((-1, p) + cache["v"].shape[1:])
+            win_rs = None if windows is None else windows.reshape(-1, p)
+
+            def body(h, layer_in):
+                groups_t, ck_t, cv_t, win_t = layer_in
+                ks, vs = [], []
+                for j, (tag, _) in enumerate(self._groups):
+                    w_j = None if win_t is None else win_t[j]
+                    h, (k_j, v_j) = dec_layer(groups_t[f"g{j}"], h, ck_t[j],
+                                              cv_t[j], w_j, tag)
+                    ks.append(k_j)
+                    vs.append(v_j)
+                return h, (jnp.stack(ks), jnp.stack(vs))
+
+            h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], ck_rs,
+                                                       cv_rs, win_rs))
+            new_k = new_k.reshape(cache["k"].shape)
+            new_v = new_v.reshape(cache["v"].shape)
+        else:   # contiguous segments
+            ks, vs = [], []
+            for gi, (tag, idxs) in enumerate(self._groups):
+                lo, n = idxs[0], len(idxs)
+                w_seg = None if windows is None else windows[lo:lo + n]
+
+                def body(h, layer_in, _tag=tag):
+                    lp, ck, cv, win = layer_in
+                    return dec_layer(lp, h, ck, cv, win, _tag)
+
+                h, (k_g, v_g) = jax.lax.scan(
+                    body, h, (params["layers"][f"g{gi}"], cache["k"][lo:lo + n],
+                              cache["v"][lo:lo + n], w_seg))
+                ks.append(k_g)
+                vs.append(v_g)
+            new_k = jnp.concatenate(ks)
+            new_v = jnp.concatenate(vs)
         h = L.apply_norm(params["final_norm"], h, cfg)
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("bse,ve->bsv", h, params["embed"]["tok"].astype(dt))
-        else:
-            logits = jnp.einsum("bse,ev->bsv", h, params["embed"]["lm_head"].astype(dt))
-        if "lm_head_bias" in params["embed"]:
-            logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
+        w, transpose = self._lm_head_weight(params)
+        logits = lm_head_logits(h, w, transpose, dt,
+                                bias=params["embed"].get("lm_head_bias"),
+                                softcap=cfg.logit_softcap)
         return logits, {"k": new_k, "v": new_v}
 
     # -- loss --
@@ -384,7 +545,8 @@ class CausalLM:
                                         segment_ids=batch.get("segment_ids"))
             w, transpose = self._lm_head_weight(params)
             loss = lm_cross_entropy(h, w.astype(h.dtype), labels, loss_mask=mask,
-                                    n_chunks=cfg.loss_chunks, transpose_w=transpose)
+                                    n_chunks=cfg.loss_chunks, transpose_w=transpose,
+                                    softcap=cfg.logit_softcap)
         else:
             logits, aux = self.apply(params, batch["input_ids"],
                                      positions=batch.get("positions"),

@@ -41,13 +41,15 @@ def window_mask(q_pos, k_pos, window):
 
 
 def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
-                        window=None):
+                        window=None, softcap=0.0):
     """Plain XLA attention: (B, S, H, D) x (B, S, KVH, D) -> (B, S, H, D).
 
     Handles GQA by repeating kv heads. fp32 softmax for stability.
     ``window``: sliding-window width — query q sees keys in (q-window, q].
     May be a traced scalar (per-layer local/global patterns under scan);
     window <= 0 means global.
+    ``softcap``: Gemma-2 attention-logit softcapping, applied to the scaled
+    logits (+ bias) BEFORE masking, matching HF's order.
     """
     b, sq, h, d = q.shape
     kvh = k.shape[2]
@@ -59,6 +61,8 @@ def reference_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
     if bias is not None:
         logits = logits + bias
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
     sk = k.shape[1]
     if causal or window is not None:
         q_pos = jnp.arange(sq)[:, None] + (sk - sq)
@@ -83,18 +87,19 @@ def _alibi_bias_from_slopes(slopes, sq, sk):
 
 
 def _reference_with_slopes(q, k, v, causal, bias, alibi_slopes, segment_ids,
-                           scale, window):
+                           scale, window, softcap=0.0):
     """Single fallback entry: expand ALiBi slopes to a bias and run the XLA
     reference path (keeps the expansion in exactly one place)."""
     if alibi_slopes is not None and bias is None:
         bias = _alibi_bias_from_slopes(alibi_slopes, q.shape[1], k.shape[1])
     return reference_attention(q, k, v, causal=causal, bias=bias,
                                segment_ids=segment_ids, scale=scale,
-                               window=window)
+                               window=window, softcap=softcap)
 
 
 def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
-                        window=None, alibi_slopes=None, impl: Optional[str] = None):
+                        window=None, alibi_slopes=None, impl: Optional[str] = None,
+                        softcap=0.0):
     """Dispatching attention entry point.
 
     q: (B, S, H, D); k/v: (B, S, KVH, D). Returns (B, S, H, D).
@@ -121,15 +126,16 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         if not causal:
             raise NotImplementedError("ring attention is causal-only")
         if seq_sharded:
-            if bias is not None or window is not None or alibi_slopes is not None:
+            if bias is not None or window is not None or alibi_slopes is not None \
+                    or softcap:
                 raise NotImplementedError(
                     "ring attention does not support additive attention bias "
-                    "(ALiBi) or sliding windows; use Ulysses SP or "
-                    "attn_impl='reference'")
+                    "(ALiBi), sliding windows, or logit softcapping; use "
+                    "Ulysses SP or attn_impl='reference'")
             return ring_attention(q, k, v, scale=scale)
         # no seq axis: plain local attention
         return _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
-                                      segment_ids, scale, window)
+                                      segment_ids, scale, window, softcap)
 
     if seq_sharded:
         # Ulysses: swap sequence-sharding for head-sharding around the local
@@ -144,15 +150,15 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
     # traced per-layer windows (scan over local/global patterns) cannot be
     # static and stay on the reference path
     flash_window_ok = window is None or (isinstance(window, int) and causal)
-    if impl == "flash" and (bias is not None or not flash_window_ok):
+    if impl == "flash" and (bias is not None or not flash_window_ok or softcap):
         raise NotImplementedError(
             "the Pallas flash kernel does not take an additive attention "
-            "bias tensor or a traced/non-causal sliding window; use "
-            "attn_impl='reference' (auto dispatch already routes these "
-            "there)")
+            "bias tensor, a traced/non-causal sliding window, or logit "
+            "softcapping; use attn_impl='reference' (auto dispatch already "
+            "routes these there)")
     if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
                            q.shape[3] in (64, 128, 256) and bias is None and
-                           flash_window_ok):
+                           not softcap and flash_window_ok):
         try:
             from .pallas.flash_attention import flash_attention
             out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
@@ -174,10 +180,10 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
             if impl == "flash":
                 raise
             out = _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
-                                         segment_ids, scale, window)
+                                         segment_ids, scale, window, softcap)
     else:
         out = _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
-                                     segment_ids, scale, window)
+                                     segment_ids, scale, window, softcap)
 
     if seq_sharded:
         out = jax.lax.with_sharding_constraint(out, jax.NamedSharding(mesh, out_spec))
@@ -185,7 +191,7 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None,
-                     window=None):
+                     window=None, softcap=0.0):
     """Decode/prefill attention against a (B, S_max, KVH, D) KV cache.
 
     q: (B, S_new, H, D) — the S_new query tokens occupy cache slots
@@ -206,7 +212,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None,
     b, s_new, h, d = q.shape
     if isinstance(window, int) and window >= k_cache.shape[1]:
         window = None   # cannot bind within this cache
-    if (s_new == 1 and bias is None and window is None and _use_pallas()
+    if (s_new == 1 and bias is None and window is None and not softcap
+            and _use_pallas()
             and k_cache.shape[1] >= 8192
             and k_cache.shape[1] % 128 == 0 and d % 64 == 0
             and h % k_cache.shape[2] == 0):
@@ -235,6 +242,8 @@ def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None,
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32) * scale
     if bias is not None:
         logits = logits + bias
+    if softcap:
+        logits = softcap * jnp.tanh(logits / softcap)
     q_pos = (cache_len[:, None] - s_new) + jnp.arange(s_new)[None, :]      # (B, S_new)
     k_pos = jnp.arange(k_cache.shape[1])[None, None, :]                    # (1, 1, S_max)
     mask = k_pos <= q_pos[:, :, None]                                      # (B, S_new, S_max)

@@ -36,18 +36,20 @@ def _pad_vocab(w, v, n_chunks):
     return w, vp
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def chunked_softmax_xent(h, w, labels, n_chunks=DEFAULT_CHUNKS):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def chunked_softmax_xent(h, w, labels, n_chunks=DEFAULT_CHUNKS, softcap=0.0):
     """Per-token negative log-likelihood without materializing logits.
 
     h: (N, E) activations; w: (V, E) output embedding (logits = h @ w.T);
     labels: (N,) int32. Returns nll (N,) fp32.
+    ``softcap``: Gemma-2 final-logit softcapping, applied per chunk before
+    the online logsumexp (the backward differentiates through the tanh).
     """
-    nll, _ = _xent_fwd_core(h, w, labels, n_chunks)
+    nll, _ = _xent_fwd_core(h, w, labels, n_chunks, softcap)
     return nll
 
 
-def _xent_fwd_core(h, w, labels, n_chunks):
+def _xent_fwd_core(h, w, labels, n_chunks, softcap=0.0):
     n, e = h.shape
     v = w.shape[0]
     wp, vp = _pad_vocab(w, v, n_chunks)
@@ -59,6 +61,8 @@ def _xent_fwd_core(h, w, labels, n_chunks):
         w_c, idx = inp
         logits = jax.lax.dot_general(h, w_c, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)  # (N, C)
+        if softcap:
+            logits = softcap * jnp.tanh(logits / softcap)
         col = idx * c + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         logits = jnp.where(col < v, logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=1))
@@ -80,12 +84,12 @@ def _xent_fwd_core(h, w, labels, n_chunks):
     return lse - ll, lse
 
 
-def _xent_fwd_rule(h, w, labels, n_chunks):
-    nll, lse = _xent_fwd_core(h, w, labels, n_chunks)
+def _xent_fwd_rule(h, w, labels, n_chunks, softcap):
+    nll, lse = _xent_fwd_core(h, w, labels, n_chunks, softcap)
     return nll, (h, w, labels, lse)
 
 
-def _xent_bwd_rule(n_chunks, res, g):
+def _xent_bwd_rule(n_chunks, softcap, res, g):
     h, w, labels, lse = res
     n, e = h.shape
     v = w.shape[0]
@@ -98,11 +102,18 @@ def _xent_bwd_rule(n_chunks, res, g):
         w_c, idx = inp
         logits = jax.lax.dot_general(h, w_c, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)  # (N, C)
+        if softcap:
+            capped = softcap * jnp.tanh(logits / softcap)
+            dcap = 1.0 - jnp.square(capped / softcap)   # d(capped)/d(logits)
+            logits = capped
         col = idx * c + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
         p = jnp.exp(logits - lse[:, None])
         p = jnp.where(col < v, p, 0.0)
         onehot = (col == labels[:, None]).astype(jnp.float32)
-        dlogits = ((p - onehot) * gf[:, None]).astype(h.dtype)        # (N, C)
+        dlogits = (p - onehot) * gf[:, None]                          # (N, C)
+        if softcap:
+            dlogits = dlogits * dcap
+        dlogits = dlogits.astype(h.dtype)
         dh = dh + jax.lax.dot_general(dlogits, w_c, (((1,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dw_c = jax.lax.dot_general(dlogits, h, (((0,), (0,)), ((), ())),
@@ -119,7 +130,7 @@ chunked_softmax_xent.defvjp(_xent_fwd_rule, _xent_bwd_rule)
 
 
 def lm_cross_entropy(h, w, labels, loss_mask=None, n_chunks=DEFAULT_CHUNKS,
-                     transpose_w=False):
+                     transpose_w=False, softcap=0.0):
     """Mean cross-entropy over (B, S) tokens from final hidden states.
 
     h: (B, S, E); w: (V, E) tied embedding (or (E, V) with transpose_w);
@@ -128,7 +139,8 @@ def lm_cross_entropy(h, w, labels, loss_mask=None, n_chunks=DEFAULT_CHUNKS,
     b, s, e = h.shape
     if transpose_w:
         w = w.T
-    nll = chunked_softmax_xent(h.reshape(b * s, e), w, labels.reshape(-1), n_chunks)
+    nll = chunked_softmax_xent(h.reshape(b * s, e), w, labels.reshape(-1), n_chunks,
+                               softcap)
     nll = nll.reshape(b, s)
     if loss_mask is None:
         return jnp.mean(nll)

@@ -104,10 +104,16 @@ def check_pipeline_model_support(cfg):
             "pipeline engine supports causal pre-norm decoders only; "
             "train BERT-style encoders under ZeRO (DP/TP/SP) instead")
     if getattr(cfg, "sliding_window", None) is not None \
-            and getattr(cfg, "local_attention_every", None):
+            and getattr(cfg, "local_attention_every", None) or \
+            getattr(cfg, "window_pattern", None):
         raise NotImplementedError(
             "per-layer local/global attention patterns are not threaded "
             "through pipeline stages; uniform sliding_window is supported")
+    if getattr(cfg, "layer_types", None) and len(set(cfg.layer_types)) > 1:
+        raise NotImplementedError(
+            "heterogeneous layer stacks (cfg.layer_types) cannot be "
+            "partitioned into uniform pipeline stages yet; train them under "
+            "ZeRO (DP/TP/SP/EP) instead")
 
 
 def _pipeline_interface(model):

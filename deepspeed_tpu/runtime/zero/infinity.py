@@ -205,11 +205,16 @@ class InfinityRunner:
                 "ZeRO-Infinity streaming supports causal pre-norm decoders "
                 "only (its persistent head fabricates final_norm and uses "
                 "the causal head_loss)")
-        if model.cfg.sliding_window is not None and model.cfg.local_attention_every:
+        if (model.cfg.sliding_window is not None and
+                model.cfg.local_attention_every) or model.cfg.window_pattern:
             raise NotImplementedError(
                 "per-layer local/global window patterns are not threaded "
                 "through the Infinity layer-group scan; uniform "
                 "sliding_window is supported")
+        if model._groups is not None:
+            raise NotImplementedError(
+                "heterogeneous layer stacks (cfg.layer_types) are not "
+                "supported by the Infinity layer-group streamer yet")
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg

@@ -1,0 +1,328 @@
+"""Model-family variant coverage (round-3 verdict item 2): the HF config
+flags that previously raised NotImplementedError — Phi/StableLM qk-layernorm,
+StableLM parallel residual, Falcon new_decoder_architecture, Gemma-2
+(sandwich norms + softcapping + alternating sliding window), MPT qk_ln/rope.
+
+Parity harness mirrors tests/test_inference.py: tiny randomly-initialized HF
+models converted via init_inference, logits vs the torch forward. MPT's HF
+port ignores qk_ln/rope in its modeling code (config-only flags), so those
+are covered at the native level instead.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu as ds
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.models.config import TransformerConfig
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh_8dp):
+    yield
+
+
+def _compare_logits(hf_model, atol=2e-3, batch=2, seq=16):
+    engine = ds.init_inference(hf_model, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 100, (batch, seq))
+    with torch.no_grad():
+        want = hf_model(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(engine.forward(ids))
+    np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+    return engine
+
+
+# ---- Phi qk_layernorm ----------------------------------------------------
+
+def test_phi_qk_layernorm_logits_match():
+    cfg = transformers.PhiConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4,
+        max_position_embeddings=64, partial_rotary_factor=0.5,
+        qk_layernorm=True)
+    torch.manual_seed(0)
+    _compare_logits(transformers.PhiForCausalLM(cfg).eval())
+
+
+# ---- StableLM variants ---------------------------------------------------
+
+def _tiny_stablelm(**kw):
+    cfg = transformers.StableLmConfig(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=64, partial_rotary_factor=0.25, **kw)
+    torch.manual_seed(0)
+    # HF's StableLm _init_weights assumes every LayerNorm has a bias and
+    # crashes on the bias-free per-head qk norms; build with torch default
+    # init instead and randomize the LN scales so a wrong per-head weight
+    # mapping can't silently pass the parity check.
+    from transformers.modeling_utils import no_init_weights
+    with no_init_weights():
+        model = transformers.StableLmForCausalLM(cfg)
+    with torch.no_grad():
+        for m in model.modules():
+            if isinstance(m, torch.nn.LayerNorm):
+                m.weight.normal_(1.0, 0.3)
+                if m.bias is not None:
+                    m.bias.normal_(0.0, 0.1)
+    return model.eval()
+
+
+def test_stablelm_qk_layernorm_logits_match():
+    _compare_logits(_tiny_stablelm(qk_layernorm=True))
+
+
+def test_stablelm_parallel_residual_logits_match():
+    _compare_logits(_tiny_stablelm(use_parallel_residual=True))
+
+
+def test_stablelm_parallel_qk_ln_qkv_bias_logits_match():
+    """All three variant flags at once."""
+    _compare_logits(_tiny_stablelm(use_parallel_residual=True,
+                                   qk_layernorm=True, use_qkv_bias=True))
+
+
+# ---- Falcon new_decoder_architecture ------------------------------------
+
+def test_falcon_new_decoder_architecture_logits_match():
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2,
+        new_decoder_architecture=True, parallel_attn=True, bias=False,
+        max_position_embeddings=64)
+    torch.manual_seed(0)
+    _compare_logits(transformers.FalconForCausalLM(cfg).eval())
+
+
+def test_falcon_new_arch_greedy_matches_hf():
+    cfg = transformers.FalconConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_kv_heads=2,
+        new_decoder_architecture=True, parallel_attn=True, bias=False,
+        max_position_embeddings=64)
+    torch.manual_seed(1)
+    hf = transformers.FalconForCausalLM(cfg).eval()
+    engine = ds.init_inference(hf, dtype="float32")
+    ids = np.random.default_rng(3).integers(0, 100, (1, 8))
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=8, do_sample=False,
+                           pad_token_id=0).numpy()
+    got = np.asarray(engine.generate(ids, max_new_tokens=8))
+    np.testing.assert_array_equal(got, want)
+
+
+# ---- Gemma-2 -------------------------------------------------------------
+
+def _tiny_gemma2(n_layers=4, **kw):
+    cfg = transformers.Gemma2Config(
+        vocab_size=128, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=n_layers, num_attention_heads=4,
+        num_key_value_heads=2, head_dim=8, max_position_embeddings=64,
+        query_pre_attn_scalar=8, sliding_window=8,
+        attn_logit_softcapping=50.0, final_logit_softcapping=30.0, **kw)
+    torch.manual_seed(0)
+    return transformers.Gemma2ForCausalLM(cfg).eval()
+
+
+def test_gemma2_logits_match():
+    # seq 16 > window 8 so the even (sliding) layers actually mask
+    _compare_logits(_tiny_gemma2(), atol=3e-3)
+
+
+def test_gemma2_config_mapping():
+    from deepspeed_tpu.inference.v2.model_implementations import resolve_container
+    hf = _tiny_gemma2()
+    container = resolve_container(hf.config)
+    cfg = container.config(hf.config)
+    assert cfg.sandwich_norm and cfg.attn_softcap == 50.0
+    assert cfg.logit_softcap == 30.0
+    assert cfg.attn_scale == pytest.approx(8 ** -0.5)
+    # HF: even-indexed layers slide
+    assert cfg.window_pattern == (8, 0, 8, 0)
+
+
+def test_gemma2_greedy_matches_hf():
+    hf = _tiny_gemma2(n_layers=2)
+    engine = ds.init_inference(hf, dtype="float32")
+    ids = np.random.default_rng(5).integers(0, 100, (1, 12))
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(ids), max_new_tokens=6, do_sample=False,
+                           pad_token_id=0).numpy()
+    got = np.asarray(engine.generate(ids, max_new_tokens=6))
+    np.testing.assert_array_equal(got, want)
+
+
+# ---- chunked CE with logit softcap ---------------------------------------
+
+def test_chunked_cross_entropy_softcap_matches_dense():
+    """The fused vocab-chunked loss must equal the dense softcapped loss
+    (value and gradients) so Gemma-2 training can keep the chunked path."""
+    from deepspeed_tpu.ops.cross_entropy import lm_cross_entropy
+    rng = np.random.default_rng(0)
+    b, s, e, v, cap = 2, 8, 16, 64, 5.0
+    h = jnp.asarray(rng.normal(size=(b, s, e)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(v, e)), jnp.float32)
+    labels = jnp.asarray(rng.integers(0, v, (b, s)), jnp.int32)
+
+    def dense(h, w):
+        logits = jnp.einsum("bse,ve->bsv", h, w)
+        logits = cap * jnp.tanh(logits / cap)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, labels[..., None], -1)[..., 0]
+        return jnp.mean(lse - ll)
+
+    def chunked(h, w):
+        return lm_cross_entropy(h, w, labels, n_chunks=4, softcap=cap)
+
+    want, (dh_w, dw_w) = jax.value_and_grad(dense, argnums=(0, 1))(h, w)
+    got, (dh_g, dw_g) = jax.value_and_grad(chunked, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(dh_g), np.asarray(dh_w), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dw_g), np.asarray(dw_w), atol=1e-5)
+
+
+# ---- MPT variants (native-level: HF's port ignores qk_ln/rope) ----------
+
+def _tiny_variant_cfg(**kw):
+    base = dict(vocab_size=256, hidden_size=64, num_layers=2, num_heads=4,
+                intermediate_size=128, max_seq_len=128, dtype="float32",
+                param_dtype="float32")
+    base.update(kw)
+    return TransformerConfig(**base)
+
+
+@pytest.mark.parametrize("mode", ["full", "head_dim", "per_head"])
+def test_qk_norm_decode_matches_forward(mode):
+    """All three qk-norm layouts: scan decode == full forward."""
+    cfg = _tiny_variant_cfg(qk_norm=mode, activation="gelu_exact",
+                            norm="layernorm", position="alibi")
+    model = build_model(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng)
+    ids = jax.random.randint(rng, (2, 8), 0, cfg.vocab_size)
+    full = model.apply(params, ids)
+    cache = model.init_cache(2, 16)
+    cache_len = jnp.zeros((2,), jnp.int32)
+    logits, cache = model.apply_decode(params, ids, cache, cache_len)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_qk_norm_mpt_rope_trains():
+    """MPT rope + qk_ln variant config: loss decreases, grads finite."""
+    cfg = _tiny_variant_cfg(qk_norm="full", position="rope",
+                            activation="gelu_exact", norm="layernorm")
+    model = build_model(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng)
+    ids = jax.random.randint(rng, (2, 16), 0, cfg.vocab_size)
+    batch = {"input_ids": ids, "labels": ids}
+    loss, grads = jax.value_and_grad(model.loss)(params, batch)
+    assert np.isfinite(float(loss))
+    assert all(np.all(np.isfinite(np.asarray(g))) for g in jax.tree.leaves(grads))
+
+
+def test_qk_norm_full_matches_manual():
+    """qk_norm='full' must equal a LayerNorm over the flattened head dims
+    (the MPT q_ln/k_ln semantics)."""
+    from deepspeed_tpu.models.layers import apply_qk_norm
+    cfg = _tiny_variant_cfg(qk_norm="full", norm="layernorm")
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(2, 4, 4, 16)), jnp.float32)  # (B,S,H,D)
+    scale = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    bias = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    got = apply_qk_norm({"scale": scale, "bias": bias}, x, cfg)
+    flat = np.asarray(x).reshape(2, 4, 64)
+    mu = flat.mean(-1, keepdims=True)
+    var = flat.var(-1, keepdims=True)
+    want = ((flat - mu) / np.sqrt(var + cfg.norm_eps)) * np.asarray(scale) + np.asarray(bias)
+    np.testing.assert_allclose(np.asarray(got).reshape(2, 4, 64), want, atol=1e-5)
+
+
+# ---- heterogeneous layer stacks (Qwen2-MoE sparse/dense interleave) ------
+
+def _tiny_qwen2moe(**kw):
+    cfg = transformers.Qwen2MoeConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=64,
+        moe_intermediate_size=48, shared_expert_intermediate_size=80,
+        num_experts=4, num_experts_per_tok=2, max_position_embeddings=64,
+        **kw)
+    torch.manual_seed(0)
+    return transformers.Qwen2MoeForCausalLM(cfg).eval()
+
+
+def test_layer_plan_shapes():
+    from deepspeed_tpu.models.transformer import layer_plan, layer_groups
+    base = TransformerConfig(num_layers=4, num_experts=2)
+    assert layer_plan(base) is None
+    alt = base.replace(layer_types=("dense", "moe", "dense", "moe"))
+    assert layer_plan(alt) == ("periodic", 2)
+    assert layer_groups(alt) == [("dense", (0, 2)), ("moe", (1, 3))]
+    pre = base.replace(layer_types=("dense", "moe", "moe", "moe"))
+    assert layer_plan(pre) == ("segments", [("dense", 0, 1), ("moe", 1, 3)])
+    assert layer_groups(pre) == [("dense", (0,)), ("moe", (1, 2, 3))]
+
+
+def test_qwen2moe_sparse_step_logits_match():
+    """decoder_sparse_step=2: alternating dense/moe — the periodic plan."""
+    hf = _tiny_qwen2moe(decoder_sparse_step=2, mlp_only_layers=[])
+    engine = ds.init_inference(hf, dtype="float32")
+    ids = np.random.default_rng(0).integers(0, 100, (1, 8))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(engine.forward(ids))
+    np.testing.assert_allclose(got, want, atol=1e-2, rtol=1e-3)
+
+
+def test_qwen2moe_mlp_only_layers_logits_match():
+    """mlp_only_layers=[0]: a dense prefix — the segments plan."""
+    hf = _tiny_qwen2moe(decoder_sparse_step=1, mlp_only_layers=[0])
+    engine = ds.init_inference(hf, dtype="float32")
+    ids = np.random.default_rng(1).integers(0, 100, (1, 8))
+    with torch.no_grad():
+        want = hf(torch.tensor(ids)).logits.numpy()
+    got = np.asarray(engine.forward(ids))
+    np.testing.assert_allclose(got, want, atol=1e-2, rtol=1e-3)
+
+
+def test_heterogeneous_decode_matches_forward():
+    """Grouped decode (periodic plan) == full forward on a native model."""
+    cfg = _tiny_variant_cfg(num_experts=2, num_layers=4,
+                            layer_types=("dense", "moe", "dense", "moe"),
+                            moe_intermediate_size=96)
+    model = build_model(cfg)
+    rng = jax.random.PRNGKey(0)
+    params = model.init(rng)
+    ids = jax.random.randint(rng, (2, 8), 0, cfg.vocab_size)
+    full = model.apply(params, ids)
+    cache = model.init_cache(2, 16)
+    logits, cache = model.apply_decode(params, ids, cache,
+                                       jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full),
+                               atol=2e-4, rtol=1e-4)
+
+
+def test_heterogeneous_stack_trains_under_engine():
+    """A het stack must train through deepspeed_tpu.initialize (sharding
+    rules walk the grouped tree)."""
+    cfg = _tiny_variant_cfg(num_experts=2, num_layers=2,
+                            layer_types=("dense", "moe"))
+    model = build_model(cfg)
+    engine, _, _, _ = ds.initialize(model=model, config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": 2}})
+    rng = np.random.default_rng(0)
+    batch = engine.stage_batch(
+        {"input_ids": rng.integers(0, 200, (8, 16), dtype=np.int32),
+         "labels": rng.integers(0, 200, (8, 16), dtype=np.int32)})
+    l0 = float(jax.device_get(engine.train_batch(batch)))
+    for _ in range(4):
+        loss = engine.train_batch(batch)
+    assert float(jax.device_get(loss)) < l0
