@@ -1,14 +1,28 @@
 #!/usr/bin/env python
-"""Serving micro-benchmark: FastGen-analog decode throughput.
+"""Serving benchmark: FastGen-analog measured end to end.
 
-Measures tokens/sec of the compiled multi-token decode loop (Pallas paged
-attention over in-place KV pages) at several batch sizes — the serving-side
-counterpart of bench.py's training number. Reference bar: FastGen's
-throughput claims (BASELINE.md).
+Produces the recorded artifact the round-2 review demanded (SERVING_rNN.json
+via `python benchmarks/serving_bench.py > SERVING_rNN.json`): one JSON object
+with a row per workload — decode-heavy, prefill-heavy, and mixed Dynamic-
+SplitFuse — each carrying tokens/sec, per-step latency p50/p95, KV-pool
+utilization, and host-scheduler overhead, plus the paged-Pallas vs XLA-gather
+decode delta. Reference bar shape: ``blogs/deepspeed-fastgen/README.md:28,139``
+(FastGen reports effective throughput and p50/p95 latency trade-offs; the
+absolute rows here are gpt2-small-class on one v5e chip).
+
+Methodology (tunneled single-chip platform, see bench.py):
+- decode throughput uses the COMPILED multi-token loop (one dispatch for N
+  tokens) — per-dispatch tunnel latency would otherwise dominate;
+- the mixed workload intentionally uses host-driven ``step()`` so the number
+  includes the real SplitFuse scheduler cost, which is reported separately
+  as ``sched_overhead_pct`` (host wall-time share of the step loop);
+- timings sync via device_get of values data-dependent on the step.
 """
 
 import json
+import logging
 import os
+import statistics
 import sys
 import time
 
@@ -17,45 +31,247 @@ import numpy as np
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def bench(batch, model_name="gpt2-small", prompt_len=128, new_tokens=64):
-    import jax
+def _logs_to_stderr():
+    """The package logger streams to stdout (reference behavior); the bench
+    must keep stdout pure JSON so `> SERVING_rNN.json` works as documented."""
+    for h in logging.getLogger("DeepSpeedTPU").handlers:
+        if hasattr(h, "stream"):
+            h.stream = sys.stderr
+
+
+def _mk_engine(model_name, batch, max_seq_len=None):
     from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                       RaggedInferenceEngineConfig)
     from deepspeed_tpu.models import build_model
-
-    platform = jax.default_backend()
-    if platform != "tpu":
-        model_name, prompt_len, new_tokens = "tiny", 16, 8
     cfg = RaggedInferenceEngineConfig(
         max_ragged_batch_size=max(batch, 16),
         max_tokens_per_step=max(batch * 2, 768),
     )
     model = build_model(model_name)
-    eng = InferenceEngineV2(model, cfg)
+    return InferenceEngineV2(model, cfg, max_seq_len=max_seq_len)
+
+
+def bench_platform_floor():
+    """Measured per-op floor of the tunneled chip — the context for every
+    absolute number in this artifact: streamed-HBM ops cost ~2 ms regardless
+    of size (~15 GB/s effective vs the 819 GB/s v5e spec), so decode steps
+    are op-floor-bound here, not a property of the engine design."""
+    import time
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    n = 32 * 1024 * 1024 // 2
+    xs = jnp.ones((8, n), jnp.bfloat16)
+
+    @jax.jit
+    def run(xs, c):
+        def body(c, x):
+            return c + jnp.sum(x.astype(jnp.float32)), ()
+        def rep(c, _):
+            c, _n = lax.scan(body, c, xs)
+            return c, ()
+        c, _ = lax.scan(rep, c, None, length=6)
+        return c
+
+    c0 = jnp.zeros((), jnp.float32)
+    run(xs, c0)
+    jax.device_get(run(xs, c0))
+    best = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        jax.device_get(run(xs, c0))
+        best = min(best, time.perf_counter() - t0)
+    per = best / 48
+    return {"workload": "platform-floor",
+            "stream_32mb_op_ms": round(per * 1e3, 3),
+            "effective_hbm_gbps": round(32 / 1024 / per, 1)}
+
+
+def _kv_util(eng):
+    total = eng.kv.num_blocks
+    return round(1.0 - eng.kv.free_blocks / total, 4)
+
+
+def bench_decode(model_name, batch, prompt_len, new_tokens):
+    """Decode-heavy: steady-state generation throughput (compiled loop)."""
+    eng = _mk_engine(model_name, batch)
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(0, model.cfg.vocab_size, (prompt_len,)).astype(np.int32)
+    prompts = [rng.integers(0, eng.model.cfg.vocab_size, (prompt_len,)).astype(np.int32)
                for _ in range(batch)]
-    # warmup (compiles prefill chunks + decode loop at both step counts)
-    eng.generate(prompts, max_new_tokens=4)
+    eng.generate(prompts, max_new_tokens=4)          # compile both step counts
     eng.generate(prompts, max_new_tokens=new_tokens)
-    # decode throughput = marginal cost of (new_tokens - 4) extra tokens,
-    # cancelling the prefill both runs share
     t0 = time.perf_counter()
     eng.generate(prompts, max_new_tokens=4)
     t1 = time.perf_counter()
+    # KV utilization at the deepest point of the long run
+    eng.put(list(range(batch)), prompts)
+    while any(eng.state.seqs[u].in_prefill for u in range(batch)):
+        eng.step()
+    util = _kv_util(eng)
+    eng.flush(list(range(batch)))
+    t1b = time.perf_counter()
     eng.generate(prompts, max_new_tokens=new_tokens)
     t2 = time.perf_counter()
-    dt = (t2 - t1) - (t1 - t0)
+    decode_dt = (t2 - t1b) - (t1 - t0)               # marginal decode cost
     toks = batch * (new_tokens - 4)
-    return {"batch": batch, "decode_tok_per_sec": round(toks / dt, 1),
-            "e2e_tok_per_sec": round(batch * new_tokens / (t2 - t1), 1),
-            "prompt_len": prompt_len, "new_tokens": new_tokens,
-            "platform": platform}
+    return {
+        "workload": "decode-heavy", "batch": batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_tok_per_sec": round(toks / decode_dt, 1),
+        "decode_ms_per_token_per_seq": round(decode_dt / (new_tokens - 4) * 1e3, 2),
+        "e2e_tok_per_sec": round(batch * new_tokens / (t2 - t1b), 1),
+        "kv_util_after_prefill": util,
+    }
+
+
+def bench_prefill(model_name, batch, prompt_len):
+    """Prefill-heavy: prompt-token ingestion throughput via SplitFuse chunks."""
+    eng = _mk_engine(model_name, batch)
+    rng = np.random.default_rng(1)
+
+    def run():
+        prompts = [rng.integers(0, eng.model.cfg.vocab_size,
+                                (prompt_len,)).astype(np.int32)
+                   for _ in range(batch)]
+        uids = list(range(batch))
+        eng.put(uids, prompts)
+        lat = []
+        t0 = time.perf_counter()
+        while any(eng.state.seqs[u].in_prefill for u in uids):
+            s = time.perf_counter()
+            eng.step()
+            lat.append(time.perf_counter() - s)
+        dt = time.perf_counter() - t0
+        util = _kv_util(eng)
+        eng.flush(uids)
+        return dt, lat, util
+
+    run()                                             # compile
+    dt, lat, util = run()
+    total = batch * prompt_len
+    return {
+        "workload": "prefill-heavy", "batch": batch, "prompt_len": prompt_len,
+        "prefill_tok_per_sec": round(total / dt, 1),
+        "step_ms_p50": round(statistics.median(lat) * 1e3, 2),
+        "step_ms_p95": round(float(np.percentile(lat, 95)) * 1e3, 2),
+        "kv_util_peak": util,
+    }
+
+
+def bench_mixed(model_name, batch, prompt_len, new_tokens):
+    """Mixed SplitFuse: half the fleet decodes while half prefills — the
+    host-driven step() loop, so the scheduler cost is IN the number."""
+    eng = _mk_engine(model_name, batch)
+    rng = np.random.default_rng(2)
+    vocab = eng.model.cfg.vocab_size
+
+    def run():
+        uids_a = list(range(0, batch // 2))
+        uids_b = list(range(batch // 2, batch))
+        eng.put(uids_a, [rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+                         for _ in uids_a])
+        # drive group A into decode
+        while any(eng.state.seqs[u].in_prefill for u in uids_a):
+            eng.step()
+        # group B arrives: steps now fuse B's prefill chunks with A's decodes
+        eng.put(uids_b, [rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+                         for _ in uids_b])
+        lat, produced = [], 0
+        # time the scheduler from INSIDE step() (wrapping the bound method)
+        # so each iteration schedules exactly once
+        sched_box = [0.0]
+        orig_schedule = eng._schedule
+
+        def timed_schedule():
+            s = time.perf_counter()
+            out = orig_schedule()
+            sched_box[0] += time.perf_counter() - s
+            return out
+
+        eng._schedule = timed_schedule
+        t0 = time.perf_counter()
+        while (any(eng.state.seqs[u].in_prefill for u in uids_b)
+               or min(len(eng.state.seqs[u].generated) for u in uids_a + uids_b)
+               < new_tokens):
+            s = time.perf_counter()
+            out = eng.step()
+            produced += len(out)
+            lat.append(time.perf_counter() - s)
+        dt = time.perf_counter() - t0
+        eng._schedule = orig_schedule
+        sched_t = sched_box[0]
+        util = _kv_util(eng)
+        eng.flush(uids_a + uids_b)
+        return dt, lat, sched_t, produced, util
+
+    run()                                             # compile
+    dt, lat, sched_t, produced, util = run()
+    return {
+        "workload": "mixed-splitfuse", "batch": batch, "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "generated_tok_per_sec": round(produced / dt, 1),
+        "step_ms_p50": round(statistics.median(lat) * 1e3, 2),
+        "step_ms_p95": round(float(np.percentile(lat, 95)) * 1e3, 2),
+        "sched_overhead_pct": round(100 * sched_t / dt, 2),
+        "steps": len(lat), "kv_util_peak": util,
+    }
+
+
+def bench_kernel_delta(model_name, batch, prompt_len, new_tokens):
+    """Paged-Pallas vs XLA-gather decode delta (same workload, kernel off)."""
+    rows = {}
+    for mode, env in (("paged_pallas", "0"), ("xla_gather", "1")):
+        os.environ["DS_TPU_DISABLE_PALLAS"] = env
+        try:
+            r = bench_decode(model_name, batch, prompt_len, new_tokens)
+            rows[mode] = r["decode_tok_per_sec"]
+        finally:
+            os.environ.pop("DS_TPU_DISABLE_PALLAS", None)
+    if rows.get("xla_gather"):
+        rows["pallas_speedup"] = round(rows["paged_pallas"] / rows["xla_gather"], 3)
+    return {"workload": "kernel-delta", "batch": batch, "prompt_len": prompt_len,
+            "new_tokens": new_tokens, **rows}
 
 
 def main():
-    results = [bench(b) for b in (16, 64)]
-    print(json.dumps({"metric": "fastgen_decode_throughput", "results": results}))
+    import jax
+    _logs_to_stderr()
+    platform = jax.default_backend()
+    if platform == "tpu":
+        model, long_prompt = "gpt2-small", 768
+        decode_cfgs = [(8, 128, 128), (32, 128, 128), (64, 128, 128)]
+        prefill_cfgs = [(8, long_prompt)]
+        mixed = (16, 256, 64)
+        delta = (32, 512, 128)
+    else:   # dev smoke
+        model, long_prompt = "tiny", 64
+        decode_cfgs = [(4, 16, 16)]
+        prefill_cfgs = [(4, long_prompt)]
+        mixed = (4, 32, 8)
+        delta = (4, 32, 16)
+
+    rows = []
+    for b, p, n in decode_cfgs:
+        rows.append(bench_decode(model, b, p, n))
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    for b, p in prefill_cfgs:
+        rows.append(bench_prefill(model, b, p))
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    rows.append(bench_mixed(model, *mixed))
+    print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    rows.append(bench_kernel_delta(model, *delta))
+    print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+    if platform == "tpu":
+        rows.append(bench_platform_floor())
+        print(json.dumps(rows[-1]), file=sys.stderr, flush=True)
+
+    best_decode = max((r.get("decode_tok_per_sec", 0) for r in rows), default=0)
+    print(json.dumps({
+        "metric": "fastgen_serving",
+        "model": model, "platform": platform,
+        "value": best_decode, "unit": "decode tokens/s",
+        "rows": rows,
+    }))
 
 
 if __name__ == "__main__":

@@ -125,18 +125,56 @@ def _dequantize_fp6(packed, scales, n_padded, dtype, group_size):
 
 
 class QuantizedLinear:
-    """y = x @ dequant(Wq) (+ b). Reference ``layers.py:47``."""
+    """y = x @ dequant(Wq) (+ b). Reference ``layers.py:47``.
+
+    2-D weights with plane-aligned K use the FUSED mixed-input Pallas GEMM
+    (``ops/pallas/woq_matmul.py``): the packed weight dequantizes tile-by-
+    tile in VMEM, never materializing the bf16 weight in HBM — the analog
+    of the reference's FP6/INT4 ``cuda_linear`` kernels. Other shapes fall
+    back to dequantize-then-matmul.
+    """
 
     def __init__(self, weight, bias=None, bits: int = 8, group_size: int = 256):
-        self.wq = QuantizedParameter.quantize(weight, bits, group_size)
+        from ...ops.pallas.woq_matmul import quantize_woq
         self.bias = bias
+        self.fused = None
+        self.wq = None
+        self._wdtype = weight.dtype
+        planes = {8: 1, 6: 4, 4: 2}[bits]
+        # honor the caller's group when the fused layout supports it (K
+        # groups must tile the plane layout); otherwise try the kernel's
+        # native 128 before falling back to the flat dequant path
+        for fg in (group_size, 128):
+            if weight.ndim == 2 and weight.shape[0] % (fg * planes) == 0:
+                self.fused = quantize_woq(weight, bits, fg)
+                break
+        if self.fused is None:
+            self.wq = QuantizedParameter.quantize(weight, bits, group_size)
 
     def __call__(self, x):
-        w = self.wq.dequantized().astype(x.dtype)
-        y = x @ w
+        if self.fused is not None:
+            from ...ops.pallas.woq_matmul import woq_matmul
+            lead = x.shape[:-1]
+            y = woq_matmul(x.reshape(-1, x.shape[-1]), self.fused)
+            y = y.reshape(*lead, y.shape[-1])
+        else:
+            w = self.wq.dequantized().astype(x.dtype)
+            y = x @ w
         if self.bias is not None:
             y = y + self.bias.astype(x.dtype)
         return y
+
+    def dequantized(self):
+        if self.fused is not None:
+            from ...ops.pallas.woq_matmul import woq_dequantize
+            return woq_dequantize(self.fused, self._wdtype)
+        return self.wq.dequantized()
+
+    @property
+    def nbytes(self):
+        if self.fused is not None:
+            return self.fused["q"].size + self.fused["scales"].size * 4
+        return self.wq.nbytes
 
 
 class QuantizedEmbedding:

@@ -9,10 +9,12 @@ different chunk widths, which is the Dynamic-SplitFuse unification.
 Per layer, inside a ``lax.scan`` over the stacked params zipped with the KV
 pools' layer slices ((KVH, NB, bs, D) — kv-head-major): project q/k/v, RoPE
 at absolute positions, scatter the chunk's KV into its pages, then attend.
-Decode steps (C=1) use the Pallas paged kernel
-(``ops/pallas/paged_attention.py``) which reads pages IN PLACE via the block
-table; prefill chunks gather pages (the gather amortizes over the chunk's
-matmuls). Pools are donated, so XLA updates pages in place.
+BOTH decode steps (C=1) and prefill chunks (C>1) run the unified Pallas
+paged kernel (``ops/pallas/paged_attention.py``), which reads pages IN
+PLACE via the block table and handles causal masks, sliding windows, ALiBi,
+and attention softcapping in-kernel; the XLA gather path remains as the
+non-TPU/escape-hatch fallback. Pools are donated, so XLA updates pages in
+place.
 """
 
 import functools
@@ -116,14 +118,18 @@ class PagedModelRunner:
                                  interleaved=cfg.rope_interleaved)
             kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
             vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
-            if (c == 1 and _use_pallas_paged() and cfg.position != "alibi"
-                    and win is None and not cfg.attn_softcap):
-                # decode: Pallas kernel reads pages in place (no gather)
-                from ...ops.pallas.paged_attention import paged_decode_attention
-                out = paged_decode_attention(
-                    q[:, 0], kp, vp, block_tables,
-                    seq_lens=jnp.maximum(positions[:, 0] + 1, 0),
-                    scale=cfg.attn_scale)[:, None]
+            if _use_pallas_paged():
+                # decode AND chunked prefill read pages in place (no
+                # gather); causal masking, sliding windows (uniform or
+                # per-layer traced), ALiBi, and attention softcapping all
+                # run in-kernel (the FastGen blocked-flash surface)
+                from ...ops.pallas.paged_attention import paged_ragged_attention
+                slopes = (L.alibi_slopes(cfg.num_heads)
+                          if cfg.position == "alibi" else None)
+                out = paged_ragged_attention(
+                    q, kp, vp, block_tables, positions,
+                    scale=cfg.attn_scale, window=win, alibi_slopes=slopes,
+                    softcap=cfg.attn_softcap)
             else:
                 kpages = kp[:, block_tables].reshape(
                     cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
@@ -231,12 +237,15 @@ def _paged_attention(q, kpages, vpages, positions, cfg, window=None):
     scale = cfg.attn_scale if cfg.attn_scale is not None else d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kpages,
                         preferred_element_type=jnp.float32) * scale
-    if cfg.attn_softcap:
-        logits = cfg.attn_softcap * jnp.tanh(logits / cfg.attn_softcap)
     if cfg.position == "alibi":
         # gathered page slot index IS the absolute sequence position
         logits = logits + L.alibi_bias(
             cfg.num_heads, jnp.maximum(positions, 0), jnp.arange(kpages.shape[1]))
+    # softcap AFTER the bias — the order the Pallas kernel and
+    # reference_attention use (ALiBi and softcapping never co-occur in the
+    # supported families, but the two paths must stay bit-comparable)
+    if cfg.attn_softcap:
+        logits = cfg.attn_softcap * jnp.tanh(logits / cfg.attn_softcap)
     k_pos = jnp.arange(kpages.shape[1])[None, None, :]
     mask = k_pos <= positions[:, :, None]               # (B, C, S_pad); pad rows all-False
     if window is not None:

@@ -126,13 +126,14 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         if not causal:
             raise NotImplementedError("ring attention is causal-only")
         if seq_sharded:
-            if bias is not None or window is not None or alibi_slopes is not None \
-                    or softcap:
+            if bias is not None or softcap:
                 raise NotImplementedError(
-                    "ring attention does not support additive attention bias "
-                    "(ALiBi), sliding windows, or logit softcapping; use "
-                    "Ulysses SP or attn_impl='reference'")
-            return ring_attention(q, k, v, scale=scale)
+                    "ring attention takes ALiBi as slopes (not an explicit "
+                    "bias tensor) and has no logit softcapping; use Ulysses "
+                    "SP or attn_impl='reference'")
+            return ring_attention(q, k, v, scale=scale, window=window,
+                                  alibi_slopes=alibi_slopes,
+                                  segment_ids=segment_ids)
         # no seq axis: plain local attention
         return _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
                                       segment_ids, scale, window, softcap)

@@ -1,4 +1,4 @@
-"""Pallas paged decode attention: flash attention over in-place KV pages.
+"""Pallas paged attention: flash attention over in-place KV pages.
 
 Analog of the reference's blocked-flash ragged kernel
 (``inference/v2/kernels/ragged_ops/blocked_flash/flash.h``): each sequence's
@@ -6,16 +6,19 @@ KV lives scattered across fixed-size pages of a global pool; attention reads
 the pages IN PLACE via the block table — the (B, S_max, KVH, D) gathered
 cache the XLA fallback materializes never exists.
 
-TPU mapping: the block table and sequence lengths are scalar-prefetched
-(``pltpu.PrefetchScalarGridSpec``) so the kernel's BlockSpec index_map can
-chase page indices while the pipeline double-buffers page fetches. Grid =
-(batch, kv_head, page); online-softmax state (m, l, acc) lives in VMEM
-scratch carried across the page dimension of the grid. GQA runs the q-head
-group of each kv head as rows of one (G, D) tile.
+TPU mapping: the block table and per-sequence page bounds are
+scalar-prefetched (``pltpu.PrefetchScalarGridSpec``) so the kernel's
+BlockSpec index_map can chase page indices while the pipeline
+double-buffers page fetches. Grid = (batch, kv_head, page); online-softmax
+state (m, l, acc) lives in VMEM scratch carried across the page dimension.
+GQA runs the q-head group of each kv head as rows of one tile.
 
-Decode-only (one query token per sequence); prefill chunks use the XLA
-path in ``inference/v2/model_runner.py`` where the gather amortizes over
-the chunk's matmuls.
+One kernel covers BOTH decode (C == 1) and chunked prefill (C > 1) — the
+Dynamic-SplitFuse unification: queries are rows of a (C*G, D) tile whose
+per-row absolute positions ride in as an f32 block, so per-row causal
+masking, sliding windows, and ALiBi (reference blocked-flash handles these
+in-kernel too) need no gathered bias tensors. Pages wholly outside
+[min_pos - window, max_pos] are skipped by the grid predicate.
 """
 
 import functools
@@ -28,11 +31,11 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _decode_kernel(bt_ref, len_ref,            # scalar prefetch
-                   q_ref, k_ref, v_ref,        # blocks
-                   o_ref,                      # output
-                   m_ref, l_ref, acc_ref,      # VMEM scratch
-                   *, page_size, pages_max, scale):
+def _paged_kernel(bt_ref, len_ref, lo_ref, win_ref,   # scalar prefetch
+                  q_ref, k_ref, v_ref, pos_ref, slope_ref,   # blocks
+                  o_ref,                          # output
+                  m_ref, l_ref, acc_ref,          # VMEM scratch
+                  *, page_size, pages_max, scale, softcap, use_alibi):
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -43,18 +46,36 @@ def _decode_kernel(bt_ref, len_ref,            # scalar prefetch
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
     seq_len = len_ref[b]
+    win = win_ref[0]          # runtime: 0/negative = global (per-layer
+    # window patterns arrive as traced scan elements, so the window cannot
+    # be a compile-time constant)
+    # pages entirely below every query's window are dead (lo_ref
+    # pre-computes the lowest visible slot; 0 when global)
+    active = jnp.logical_and(j * page_size < seq_len,
+                             (j + 1) * page_size > lo_ref[b])
 
-    @pl.when(j * page_size < seq_len)
+    @pl.when(active)
     def _page():
-        q = q_ref[0, 0]                                   # (G, D)
+        q = q_ref[0, 0]                                   # (R, D) R = C*G
         k = k_ref[0, 0]                                   # (bs, D)
         v = v_ref[0, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)   # (G, bs)
+                                preferred_element_type=jnp.float32)   # (R, bs)
         if scale != 1.0:
             s = s * scale
-        slot = j * page_size + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        s = jnp.where(slot < seq_len, s, NEG_INF)
+        slot = (j * page_size
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)).astype(jnp.float32)
+        pos = pos_ref[0, 0].reshape(-1, 1)                # (R, 1) f32
+        if use_alibi:
+            # slope block is already this kv-head's (1, 1, R) slice
+            s = s + slope_ref[0, 0].reshape(-1, 1) * (slot - pos)
+        if softcap:
+            s = softcap * jnp.tanh(s / softcap)
+        mask = slot <= pos
+        wf = win.astype(jnp.float32)
+        mask = jnp.logical_and(mask,
+                               jnp.logical_or(win <= 0, slot > pos - wf))
+        s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -73,50 +94,104 @@ def _decode_kernel(bt_ref, len_ref,            # scalar prefetch
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q, kpool, vpool, block_tables, seq_lens, *, scale=None):
-    """q: (B, H, D); kpool/vpool: (KVH, NB, bs, D) kv-head-major page pools;
-    block_tables: (B, MB) int32 page ids per sequence (in order);
-    seq_lens: (B,) int32 tokens currently in each sequence (incl. the one
-    being decoded). Returns (B, H, D)."""
-    b, h, d = q.shape
+def paged_ragged_attention(q, kpool, vpool, block_tables, positions, *,
+                           scale=None, window=0, alibi_slopes=None,
+                           softcap=0.0):
+    """Unified paged attention for decode AND chunked prefill.
+
+    q: (B, C, H, D) — C query tokens per sequence (1 = decode);
+    kpool/vpool: (KVH, NB, bs, D) kv-head-major page pools (chunk KV already
+    scattered in); block_tables: (B, MB) int32 page ids; positions: (B, C)
+    int32 absolute slot of each query, -1 for padding rows (their outputs
+    are garbage the caller discards). Query at slot p attends slots <= p,
+    within (p - window, p] when ``window`` > 0; ``alibi_slopes``: (H,)
+    per-head slopes applied in-kernel; ``softcap``: Gemma-2 attention-logit
+    tanh cap. Returns (B, C, H, D).
+    """
+    b, c, h, d = q.shape
     kvh, nb, page_size, _ = kpool.shape
     mb = block_tables.shape[1]
     group = h // kvh
+    rows = c * group
     scale = float(scale if scale is not None else d ** -0.5)
+    if window is None:
+        window = 0
+    softcap = float(softcap or 0.0)
 
-    # (B, H, D) → (B, KVH, G, D): one grid cell per (batch, kv head)
-    qg = q.reshape(b, kvh, group, d)
-    kp, vp = kpool, vpool
+    # (B, C, H, D) → (B, KVH, C*G, D): row r = c*G + g
+    qg = q.reshape(b, c, kvh, group, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, kvh, rows, d)
+    # per-row positions (B, 1, C*G) as f32 (exact to 2^24; int blocks are
+    # fragile on the tunneled Mosaic compiler — see verify skill notes)
+    pos_rep = jnp.repeat(positions, group, axis=1).astype(jnp.float32)
+    pos_rep = pos_rep.reshape(b, 1, rows)
+    valid = positions >= 0
+    seq_lens = (jnp.max(jnp.where(valid, positions, -1), axis=1) + 1).astype(jnp.int32)
+    win_arr = jnp.asarray(window, jnp.int32).reshape(1)
+    minpos = jnp.min(jnp.where(valid, positions, 1 << 30), axis=1)
+    lo = jnp.where(win_arr[0] > 0,
+                   jnp.maximum(minpos - win_arr[0] + 1, 0),
+                   0).astype(jnp.int32)
+
+    use_alibi = alibi_slopes is not None
+    if use_alibi:
+        sl = jnp.asarray(alibi_slopes, jnp.float32).reshape(kvh, group)
+        slopes = jnp.tile(sl, (1, c)).reshape(kvh, 1, rows)
+    else:
+        slopes = jnp.zeros((kvh, 1, rows), jnp.float32)
 
     grid = (b, kvh, mb)
 
-    def q_map(bi, hi, ji, bt, lens):
+    def q_map(bi, hi, ji, bt, lens, lo_, w_):
         return (bi, hi, 0, 0)
 
-    def kv_map(bi, hi, ji, bt, lens):
+    def kv_map(bi, hi, ji, bt, lens, lo_, w_):
         return (hi, bt[bi, ji], 0, 0)
 
+    def pos_map(bi, hi, ji, bt, lens, lo_, w_):
+        return (bi, 0, 0)
+
+    def slope_map(bi, hi, ji, bt, lens, lo_, w_):
+        return (hi, 0, 0)
+
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, page_size=page_size, pages_max=mb,
-                          scale=scale),
+        functools.partial(_paged_kernel, page_size=page_size, pages_max=mb,
+                          scale=scale, softcap=softcap,
+                          use_alibi=use_alibi),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=4,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, group, d), q_map),
+                pl.BlockSpec((1, 1, rows, d), q_map),
                 pl.BlockSpec((1, 1, page_size, d), kv_map),
                 pl.BlockSpec((1, 1, page_size, d), kv_map),
+                pl.BlockSpec((1, 1, rows), pos_map),
+                pl.BlockSpec((1, 1, rows), slope_map),
             ],
-            out_specs=pl.BlockSpec((1, 1, group, d), q_map),
+            out_specs=pl.BlockSpec((1, 1, rows, d), q_map),
             scratch_shapes=[
-                pltpu.VMEM((group, 1), jnp.float32),
-                pltpu.VMEM((group, 1), jnp.float32),
-                pltpu.VMEM((group, d), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, d), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, kvh, group, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kvh, rows, d), q.dtype),
         interpret=jax.default_backend() != "tpu",
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-    )(block_tables, seq_lens, qg, kp, vp)
-    return out.reshape(b, h, d)
+    )(block_tables, seq_lens, lo, win_arr, qg, kpool, vpool, pos_rep, slopes)
+    # (B, KVH, C*G, D) → (B, C, H, D)
+    return out.reshape(b, kvh, c, group, d).transpose(0, 2, 1, 3, 4).reshape(
+        b, c, h, d)
+
+
+def paged_decode_attention(q, kpool, vpool, block_tables, seq_lens, *,
+                           scale=None, window=0, alibi_slopes=None,
+                           softcap=0.0):
+    """Single-token decode wrapper: q (B, H, D), seq_lens (B,) tokens in
+    each sequence INCLUDING the one being decoded. Returns (B, H, D)."""
+    positions = (seq_lens - 1).astype(jnp.int32)[:, None]      # (B, 1)
+    out = paged_ragged_attention(q[:, None], kpool, vpool, block_tables,
+                                 positions, scale=scale, window=window,
+                                 alibi_slopes=alibi_slopes, softcap=softcap)
+    return out[:, 0]

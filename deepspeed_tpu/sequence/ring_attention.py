@@ -7,8 +7,11 @@ rotate around the ring via ``ppermute`` while flash-style online-softmax
 statistics (m, l, acc) merge partial results — peak memory stays O(S/n) per
 chip and comm rides neighbor ICI links only.
 
-Causal masking per ring step: a KV block originating from rank r is fully
-visible to Q ranks p > r, causally visible at p == r, invisible at p < r.
+Feature parity with the flash kernel (round-3): masking is computed from
+GLOBAL positions per ring step, so sliding windows, ALiBi slopes, and
+packed-sequence segment ids (which rotate around the ring with their KV
+shard) all compose with the causal ring — long-context packed pretraining
+can choose ring vs Ulysses on merit rather than on feature support.
 """
 
 import functools
@@ -22,11 +25,11 @@ from ..utils import groups
 NEG_INF = -1e30
 
 
-def _block_attend(q, k, v, scale, mode):
+def _block_attend(q, k, v, scale, mask, bias=None):
     """Partial (unnormalized) attention of local q against one kv block.
 
-    mode: 0 = skip (masked), 1 = causal (diagonal block), 2 = full.
-    Returns (m, l, o_partial): rowmax, rowsum, weighted values.
+    mask: (B|1, 1, Sq, Sk) bool visibility; bias: optional additive
+    (1, H, Sq, Sk) term (ALiBi). Returns (m, l, o_partial).
     q: (B, Sq, H, D); k/v: (B, Sk, KVH, D).
     """
     b, sq, h, d = q.shape
@@ -36,9 +39,8 @@ def _block_attend(q, k, v, scale, mode):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    sk = k.shape[1]
-    causal_mask = (jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :])[None, None]
-    mask = jnp.where(mode == 1, causal_mask, mode == 2)
+    if bias is not None:
+        s = s + bias
     s = jnp.where(mask, s, NEG_INF)
     m = jnp.max(s, axis=-1)                                   # (B, H, Sq)
     p = jnp.exp(s - m[..., None])
@@ -48,18 +50,32 @@ def _block_attend(q, k, v, scale, mode):
     return m, l, o.astype(jnp.float32)
 
 
-def _ring_body(q, k, v, axis_name, scale, vary_axes=None):
-    """Runs on one rank inside shard_map: q/k/v are local seq shards."""
+def _ring_body(q, k, v, seg, axis_name, scale, window, slopes, vary_axes=None):
+    """Runs on one rank inside shard_map: q/k/v (and segment ids) are local
+    seq shards; equal shard sizes give global positions rank*shard + i."""
     n = jax.lax.axis_size(axis_name)
     p_idx = jax.lax.axis_index(axis_name)
     b, sq, h, d = q.shape
+    sk = k.shape[1]
+    q_pos = p_idx * sq + jnp.arange(sq)                       # (Sq,) global
 
     def step(i, carry):
         m_acc, l_acc, o_acc, kv = carry
-        k_blk, v_blk = kv
+        k_blk, v_blk, kseg_blk = kv
         src = (p_idx - i) % n        # rank that produced this kv block
-        mode = jnp.where(src == p_idx, 1, jnp.where(src < p_idx, 2, 0))
-        m_b, l_b, o_b = _block_attend(q, k_blk, v_blk, scale, mode)
+        k_pos = src * sk + jnp.arange(sk)
+        rel = q_pos[:, None] - k_pos[None, :]                 # (Sq, Sk)
+        mask2 = rel >= 0                                      # causal
+        if window is not None:
+            from ..ops.attention import window_mask
+            mask2 = mask2 & window_mask(q_pos[:, None], k_pos[None, :], window)
+        mask = mask2[None, None]                              # (1,1,Sq,Sk)
+        if kseg_blk is not None:
+            mask = mask & (seg[:, None, :, None] == kseg_blk[:, None, None, :])
+        bias = None
+        if slopes is not None:
+            bias = (slopes[:, None, None] * (-rel).astype(jnp.float32))[None]
+        m_b, l_b, o_b = _block_attend(q, k_blk, v_blk, scale, mask, bias)
         m_new = jnp.maximum(m_acc, m_b)
         a_old = jnp.exp(m_acc - m_new)
         a_new = jnp.exp(m_b - m_new)
@@ -68,7 +84,9 @@ def _ring_body(q, k, v, axis_name, scale, vary_axes=None):
                  o_b * jnp.moveaxis(a_new, 1, -1)[..., None])
         perm = [(j, (j + 1) % n) for j in range(n)]
         kv_next = (jax.lax.ppermute(k_blk, axis_name, perm),
-                   jax.lax.ppermute(v_blk, axis_name, perm))
+                   jax.lax.ppermute(v_blk, axis_name, perm),
+                   None if kseg_blk is None else
+                   jax.lax.ppermute(kseg_blk, axis_name, perm))
         return m_new, l_new, o_new, kv_next
 
     axes = tuple(vary_axes) if vary_axes else (axis_name,)
@@ -82,25 +100,42 @@ def _ring_body(q, k, v, axis_name, scale, vary_axes=None):
     l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
     o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
     step = jax.checkpoint(step, static_argnums=())
-    m, l, o, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, (k, v)))
+    m, l, o, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, (k, v, seg)))
     l_safe = jnp.where(l == 0.0, 1.0, l)
     return (o / jnp.moveaxis(l_safe, 1, -1)[..., None]).astype(q.dtype)
 
 
-def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None):
+def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None,
+                   window=None, alibi_slopes=None, segment_ids=None):
     """Causal ring attention. q/k/v: (B, S, H|KVH, D) GLOBAL logical shapes,
-    seq-sharded over ``axis_name``. Returns (B, S, H, D) seq-sharded."""
+    seq-sharded over ``axis_name``. Returns (B, S, H, D) seq-sharded.
+
+    window: sliding-window width (static or traced; <= 0 = global);
+    alibi_slopes: (H,) per-head slopes; segment_ids: (B, S) int — packed
+    documents attend within their own segment only (the key-side ids rotate
+    around the ring with their shard).
+    """
     mesh = groups.get_mesh()
     d = q.shape[-1]
     scale = scale if scale is not None else d ** -0.5
     batch_axes = tuple(a for a in groups.BATCH_AXES if mesh.shape.get(a, 1) > 1) or None
     spec = P(batch_axes, axis_name, None, None)
+    seg_spec = P(batch_axes, axis_name)
+
+    slopes = None
+    if alibi_slopes is not None:
+        slopes = jnp.asarray(alibi_slopes, jnp.float32)
 
     vary_axes = (axis_name,) + (batch_axes or ())
+    has_seg = segment_ids is not None
+    body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
+                             window=window, slopes=slopes,
+                             vary_axes=vary_axes)
     fn = jax.shard_map(
-        functools.partial(_ring_body, axis_name=axis_name, scale=scale,
-                          vary_axes=vary_axes),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        body if has_seg else functools.partial(body, seg=None),
+        mesh=mesh,
+        in_specs=(spec, spec, spec) + ((seg_spec,) if has_seg else ()),
+        out_specs=spec,
         axis_names={axis_name} | (set(batch_axes) if batch_axes else set()),
         check_vma=True)
-    return fn(q, k, v)
+    return fn(q, k, v, *((segment_ids,) if has_seg else ()))

@@ -76,3 +76,114 @@ def test_fused_contiguous_decode_matches_xla():
         att._use_pallas = orig
     out = fused_decode_attention(q[:, 0], k, v, cl, block=128)[:, None]
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=1e-4)
+
+
+# ---- unified ragged kernel: prefill chunks, windows, ALiBi, softcap ------
+
+def _ragged_reference(q, kpool, vpool, tables, positions, *, window=0,
+                      alibi_slopes=None, softcap=0.0, scale=None):
+    """Gather-pages reference for the unified kernel: q (B,C,H,D),
+    positions (B,C) absolute slots (-1 pad)."""
+    kvh, nb, bs, d = kpool.shape
+    b, c, h, _ = q.shape
+    kp = kpool[:, tables].reshape(kvh, b, -1, d).transpose(1, 0, 2, 3)
+    vp = vpool[:, tables].reshape(kvh, b, -1, d).transpose(1, 0, 2, 3)
+    group = h // kvh
+    kp = jnp.repeat(kp, group, axis=1)
+    vp = jnp.repeat(vp, group, axis=1)
+    scale = scale if scale is not None else d ** -0.5
+    s = jnp.einsum("bchd,bhkd->bhck", q, kp,
+                   preferred_element_type=jnp.float32) * scale
+    slot = jnp.arange(kp.shape[2])[None, None, None, :]        # (1,1,1,S)
+    pos = positions[:, None, :, None].astype(jnp.float32)      # (B,1,C,1)
+    if alibi_slopes is not None:
+        s = s + jnp.asarray(alibi_slopes, jnp.float32)[None, :, None, None] \
+            * (slot - pos)
+    if softcap:
+        s = softcap * jnp.tanh(s / softcap)
+    mask = slot <= pos
+    if window:
+        mask = mask & (slot > pos - window)
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhck,bhkd->bchd", p, vp)
+
+
+def _ragged_case(c=4, h=4, kvh=2, d=64, **kw):
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_ragged_attention
+    b, bs, nb, mb = 2, 16, 10, 4
+    rng = np.random.default_rng(2)
+    q = jnp.asarray(rng.standard_normal((b, c, h, d)), jnp.float32) * 0.1
+    kpool = jnp.asarray(rng.standard_normal((kvh, nb, bs, d)), jnp.float32)
+    vpool = jnp.asarray(rng.standard_normal((kvh, nb, bs, d)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[: b * mb].reshape(b, mb), jnp.int32)
+    # chunk positions: seq 0 prefilling slots 17..17+c-1; seq 1 decode-ish
+    # near its end with padding rows
+    pos0 = 17 + np.arange(c)
+    pos1 = np.concatenate([[40, 41], -np.ones(max(0, c - 2))])[:c]
+    positions = jnp.asarray(np.stack([pos0, pos1]), jnp.int32)
+    out = paged_ragged_attention(q, kpool, vpool, tables, positions, **kw)
+    ref = _ragged_reference(q, kpool, vpool, tables, positions, **kw)
+    valid = np.asarray(positions) >= 0
+    np.testing.assert_allclose(np.asarray(out)[valid], np.asarray(ref)[valid],
+                               rtol=3e-5, atol=3e-5)
+
+
+def test_paged_ragged_prefill_causal():
+    _ragged_case()
+
+
+def test_paged_ragged_prefill_window():
+    _ragged_case(window=8)
+
+
+def test_paged_ragged_traced_window():
+    """Per-layer window patterns reach the kernel as traced scalars."""
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_ragged_attention
+
+    def run(win):
+        b, c, h, kvh, d, bs, nb, mb = 2, 2, 4, 2, 64, 16, 10, 4
+        rng = np.random.default_rng(3)
+        q = jnp.asarray(rng.standard_normal((b, c, h, d)), jnp.float32) * 0.1
+        kpool = jnp.asarray(rng.standard_normal((kvh, nb, bs, d)), jnp.float32)
+        vpool = jnp.asarray(rng.standard_normal((kvh, nb, bs, d)), jnp.float32)
+        tables = jnp.asarray(rng.permutation(nb)[: b * mb].reshape(b, mb), jnp.int32)
+        positions = jnp.asarray([[30, 31], [12, 13]], jnp.int32)
+        out = paged_ragged_attention(q, kpool, vpool, tables, positions,
+                                     window=win)
+        ref = _ragged_reference(q, kpool, vpool, tables, positions,
+                                window=int(win))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=3e-5, atol=3e-5)
+
+    for w in (jnp.asarray(6, jnp.int32), jnp.asarray(0, jnp.int32)):
+        run(w)
+
+
+def test_paged_ragged_alibi():
+    from deepspeed_tpu.models.layers import alibi_slopes
+    _ragged_case(h=4, kvh=4, alibi_slopes=alibi_slopes(4))
+
+
+def test_paged_ragged_softcap_and_scale():
+    _ragged_case(softcap=30.0, scale=0.2)
+
+
+def test_paged_decode_window_alibi_wrapper():
+    """Decode wrapper with window+ALiBi vs reference at C=1."""
+    from deepspeed_tpu.ops.pallas.paged_attention import paged_decode_attention
+    from deepspeed_tpu.models.layers import alibi_slopes
+    b, h, kvh, d, bs, nb, mb = 2, 4, 4, 64, 16, 8, 3
+    rng = np.random.default_rng(4)
+    q = jnp.asarray(rng.standard_normal((b, h, d)), jnp.float32) * 0.1
+    kpool = jnp.asarray(rng.standard_normal((kvh, nb, bs, d)), jnp.float32)
+    vpool = jnp.asarray(rng.standard_normal((kvh, nb, bs, d)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(nb)[: b * mb].reshape(b, mb), jnp.int32)
+    lens = jnp.asarray([30, 14], jnp.int32)
+    sl = alibi_slopes(h)
+    out = paged_decode_attention(q, kpool, vpool, tables, lens, window=9,
+                                 alibi_slopes=sl)
+    ref = _ragged_reference(q[:, None], kpool, vpool, tables,
+                            (lens - 1)[:, None], window=9, alibi_slopes=sl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref[:, 0]),
+                               rtol=3e-5, atol=3e-5)
