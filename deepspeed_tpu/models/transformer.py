@@ -344,7 +344,15 @@ class CausalLM:
         attn_bias = None
 
         windows = self._layer_windows()
-        carry = (h, jnp.zeros((), jnp.float32))
+        aux0 = jnp.zeros((), jnp.float32)
+        # inside a partial-manual shard_map (ZeRO++ quantized-collective
+        # step) the MoE aux loss becomes data-varying through the routed
+        # dispatch; the scan carry's initial value must match that vma type
+        from ..parallel.sharding import current_manual_axes
+        manual = current_manual_axes()
+        if manual:
+            aux0 = jax.lax.pvary(aux0, tuple(manual))
+        carry = (h, aux0)
 
         def make_body(fn):
             return (jax.checkpoint(fn, policy=_remat_policy(cfg.remat))

@@ -483,10 +483,11 @@ class DeepSpeedEngine:
         qw = bool(zc.zero_quantized_weights)
         qg = bool(zc.zero_quantized_gradients)
         mesh = self.mesh
-        if mesh.shape["expert"] > 1 or mesh.shape["seq"] > 1:
-            raise NotImplementedError(
-                "ZeRO++ quantized collectives currently require expert=seq=1 "
-                "(dp × tensor × zrep meshes)")
+        # expert/seq axes compose with the data-manual region: the quantized
+        # collectives are manual over "data" only, while expert dispatch and
+        # Ulysses head-swaps ride the auto axes inside the region (their
+        # sharding-constraint anchors skip manual-varying values — see
+        # _activation_constraint / apply_moe_mlp's current_manual_axes guard)
 
         leaves, treedef = jax.tree.flatten(self.param_shardings)
         p_dims = [self._data_dim(s.spec) for s in leaves]

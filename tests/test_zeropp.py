@@ -132,3 +132,38 @@ def test_quantized_collectives_int8_on_wire():
                                       if m.isdigit())]
     assert not big, f"large fp32 all-gathers remain: {big[:3]}"
 
+
+
+# ---- round-3: qwZ/qgZ composing with expert and seq mesh axes ------------
+
+def _train_mesh(config, mesh_kw, model_name="tiny", steps=3):
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(**mesh_kw))
+    model = build_model(model_name)
+    engine, _, _, _ = ds.initialize(model=model, config=config)
+    losses = [float(engine.train_batch(_make_batch(seed=i))) for i in range(steps)]
+    return losses, engine
+
+
+def test_zeropp_on_expert_mesh():
+    """qwZ+qgZ on a data x expert mesh must track the exact run (MoE expert
+    dispatch rides the auto expert axis inside the data-manual region)."""
+    cfg = _config(stage=3)
+    ref, _ = _train_mesh(cfg, {"data": 4, "expert": 2}, model_name="tiny-moe")
+    qcfg = _config(stage=3, zero_quantized_weights=True,
+                   zero_quantized_gradients=True)
+    got, engine = _train_mesh(qcfg, {"data": 4, "expert": 2},
+                              model_name="tiny-moe")
+    assert engine.mesh.shape["expert"] == 2
+    np.testing.assert_allclose(ref, got, rtol=0.05, atol=0.05)
+
+
+def test_zeropp_on_seq_mesh():
+    """qwZ+qgZ on a data x seq mesh (Ulysses SP inside the manual region)."""
+    cfg = _config(stage=3)
+    ref, _ = _train_mesh(cfg, {"data": 4, "seq": 2})
+    qcfg = _config(stage=3, zero_quantized_weights=True,
+                   zero_quantized_gradients=True)
+    got, engine = _train_mesh(qcfg, {"data": 4, "seq": 2})
+    assert engine.mesh.shape["seq"] == 2
+    np.testing.assert_allclose(ref, got, rtol=0.05, atol=0.05)
