@@ -97,6 +97,46 @@ def _reference_with_slopes(q, k, v, causal, bias, alibi_slopes, segment_ids,
                                window=window, softcap=softcap)
 
 
+def _ulysses_exchange(mesh, q, k, v, local_attn):
+    """The Ulysses head/seq exchange around a local attention computation.
+
+    Under plain SPMD jit, ``with_sharding_constraint`` pins q/k/v to
+    head-sharded and the output back to seq-sharded; XLA derives the two
+    all-to-alls from the spec flip (reference ``sequence/layer.py:145``
+    hand-codes them as ``_SeqAllToAll``).
+
+    Inside a partial-manual shard_map region (the ZeRO++ quantized-collective
+    step is manual over the data-like axes) the ``seq`` axis is Auto-typed
+    and sharding constraints may not mention it — there the exchange is
+    expressed with sharding-in-types: ``explicit_axes`` locally retypes
+    ``seq`` Explicit, ``reshard`` forces the seq->head all-to-all, the local
+    attention runs back under ``auto_axes`` (so attention impls need no
+    explicit-mode sharding rules), and a second ``reshard`` forces the
+    head->seq all-to-all out.
+    """
+    head_spec = P(groups.BATCH_AXES, None, "seq", None)
+    out_spec = P(groups.BATCH_AXES, "seq", None, None)
+
+    from ..parallel.sharding import current_manual_axes
+    if not current_manual_axes():
+        def pin(x, spec):
+            return jax.lax.with_sharding_constraint(x, jax.NamedSharding(mesh, spec))
+        out = local_attn(pin(q, head_spec), pin(k, head_spec), pin(v, head_spec))
+        return pin(out, out_spec)
+
+    seq_in = P(None, "seq", None, None)
+    head = P(None, None, "seq", None)
+
+    def inner(q, k, v):
+        q, k, v = (jax.sharding.reshard(x, head) for x in (q, k, v))
+        out = jax.sharding.auto_axes(local_attn, axes=("seq",),
+                                     out_sharding=head)(q, k, v)
+        return jax.sharding.reshard(out, seq_in)
+
+    return jax.sharding.explicit_axes(
+        inner, axes=("seq",), in_sharding=(seq_in, seq_in, seq_in))(q, k, v)
+
+
 def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, scale=None,
                         window=None, alibi_slopes=None, impl: Optional[str] = None,
                         softcap=0.0):
@@ -138,15 +178,6 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
         return _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
                                       segment_ids, scale, window, softcap)
 
-    if seq_sharded:
-        # Ulysses: swap sequence-sharding for head-sharding around the local
-        # attention; the constraints lower to all-to-all over the seq axis.
-        head_spec = P(groups.BATCH_AXES, None, "seq", None)
-        out_spec = P(groups.BATCH_AXES, "seq", None, None)
-        q = jax.lax.with_sharding_constraint(q, jax.NamedSharding(mesh, head_spec))
-        k = jax.lax.with_sharding_constraint(k, jax.NamedSharding(mesh, head_spec))
-        v = jax.lax.with_sharding_constraint(v, jax.NamedSharding(mesh, head_spec))
-
     # flash handles static-int causal windows in-kernel (block skipping);
     # traced per-layer windows (scan over local/global patterns) cannot be
     # static and stay on the reference path
@@ -157,38 +188,40 @@ def multihead_attention(q, k, v, *, causal=True, bias=None, segment_ids=None, sc
             "bias tensor, a traced/non-causal sliding window, or logit "
             "softcapping; use attn_impl='reference' (auto dispatch already "
             "routes these there)")
-    if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
-                           q.shape[3] in (64, 128, 256) and bias is None and
-                           not softcap and flash_window_ok):
-        try:
-            from .pallas.flash_attention import flash_attention
-            out = flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
-                                  scale=scale, alibi_slopes=alibi_slopes,
-                                  window=window)
-        except Exception as e:
-            # A silent fallback here would quietly cost O(S^2) memory and a
-            # large fraction of peak throughput — warn loudly, once per shape.
-            global _FALLBACK_WARNED
-            key = (q.shape, str(q.dtype))
-            if key not in _FALLBACK_WARNED:
-                _FALLBACK_WARNED.add(key)
-                import logging
-                logging.getLogger("DeepSpeedTPU").warning(
-                    "Pallas flash attention FAILED for shape %s (%s: %s); "
-                    "falling back to O(S^2) XLA attention. Performance will "
-                    "suffer — set DS_TPU_DISABLE_PALLAS=1 to silence.",
-                    q.shape, type(e).__name__, e)
-            if impl == "flash":
-                raise
-            out = _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
-                                         segment_ids, scale, window, softcap)
-    else:
-        out = _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
-                                     segment_ids, scale, window, softcap)
+
+    def dispatch(q, k, v):
+        if impl == "flash" or (impl is None and _use_pallas() and q.shape[1] >= 128 and
+                               q.shape[3] in (64, 128, 256) and bias is None and
+                               not softcap and flash_window_ok):
+            try:
+                from .pallas.flash_attention import flash_attention
+                return flash_attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                                       scale=scale, alibi_slopes=alibi_slopes,
+                                       window=window)
+            except Exception as e:
+                # A silent fallback here would quietly cost O(S^2) memory and
+                # a large fraction of peak throughput — warn loudly, once per
+                # shape.
+                global _FALLBACK_WARNED
+                key = (q.shape, str(q.dtype))
+                if key not in _FALLBACK_WARNED:
+                    _FALLBACK_WARNED.add(key)
+                    import logging
+                    logging.getLogger("DeepSpeedTPU").warning(
+                        "Pallas flash attention FAILED for shape %s (%s: %s); "
+                        "falling back to O(S^2) XLA attention. Performance "
+                        "will suffer — set DS_TPU_DISABLE_PALLAS=1 to silence.",
+                        q.shape, type(e).__name__, e)
+                if impl == "flash":
+                    raise
+        return _reference_with_slopes(q, k, v, causal, bias, alibi_slopes,
+                                      segment_ids, scale, window, softcap)
 
     if seq_sharded:
-        out = jax.lax.with_sharding_constraint(out, jax.NamedSharding(mesh, out_spec))
-    return out
+        # Ulysses: swap sequence-sharding for head-sharding around the local
+        # attention; the exchange lowers to all-to-all over the seq axis.
+        return _ulysses_exchange(mesh, q, k, v, dispatch)
+    return dispatch(q, k, v)
 
 
 def decode_attention(q, k_cache, v_cache, cache_len, *, bias=None, scale=None,

@@ -136,12 +136,13 @@ def test_quantized_collectives_int8_on_wire():
 
 # ---- round-3: qwZ/qgZ composing with expert and seq mesh axes ------------
 
-def _train_mesh(config, mesh_kw, model_name="tiny", steps=3):
+def _train_mesh(config, mesh_kw, model_name="tiny", steps=3, bs=16):
     groups.reset_mesh()
     groups.set_mesh(groups.build_mesh(**mesh_kw))
     model = build_model(model_name)
     engine, _, _, _ = ds.initialize(model=model, config=config)
-    losses = [float(engine.train_batch(_make_batch(seed=i))) for i in range(steps)]
+    losses = [float(engine.train_batch(_make_batch(seed=i, bs=bs)))
+              for i in range(steps)]
     return losses, engine
 
 
@@ -159,11 +160,34 @@ def test_zeropp_on_expert_mesh():
 
 
 def test_zeropp_on_seq_mesh():
-    """qwZ+qgZ on a data x seq mesh (Ulysses SP inside the manual region)."""
-    cfg = _config(stage=3)
-    ref, _ = _train_mesh(cfg, {"data": 4, "seq": 2})
-    qcfg = _config(stage=3, zero_quantized_weights=True,
-                   zero_quantized_gradients=True)
-    got, engine = _train_mesh(qcfg, {"data": 4, "seq": 2})
+    """qwZ+qgZ on a data x seq mesh (Ulysses SP inside the manual region).
+
+    The seq axis does NOT consume batch: train_batch = micro * gas * dp_world
+    with dp_world = 4 (the data axis alone), so train_batch is 8 here, and
+    the batches are bs=8 to match.
+    """
+    def cfg(**over):
+        c = _config(stage=3, **over)
+        c["train_batch_size"] = 8
+        return c
+
+    mesh_kw = {"data": 4, "seq": 2}
+    ref, _ = _train_mesh(cfg(), mesh_kw, bs=8)
+    got, engine = _train_mesh(cfg(zero_quantized_weights=True,
+                                  zero_quantized_gradients=True),
+                              mesh_kw, bs=8)
     assert engine.mesh.shape["seq"] == 2
+    assert engine._zeropp_enabled
     np.testing.assert_allclose(ref, got, rtol=0.05, atol=0.05)
+
+    # The Ulysses head/seq exchange must survive the manual region as real
+    # all-to-alls — numerics alone can't distinguish it from XLA silently
+    # gathering KV over seq (sharding-in-types reshard, see
+    # ops/attention.py::_ulysses_exchange).
+    batch = engine.stage_batch(_make_batch(bs=8))
+    lowered = engine._train_step_fn.lower(
+        engine.module_params, engine.opt_state, engine.scaler_state, batch,
+        jnp.float32(1e-3), gas=1)
+    txt = lowered.compile().as_text()
+    assert any("all-to-all" in ln for ln in txt.splitlines()), \
+        "no all-to-all in the compiled ZeRO++ x SP step"
