@@ -76,8 +76,9 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         for key, (value, shardings) in (template or {}).items():
             if shardings is None:
                 abstract[key] = jax.tree.map(
-                    lambda x: jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype
-                                                   if not hasattr(x, "dtype") else x.dtype), value)
+                    lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+                    else jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype
+                                              if not hasattr(x, "dtype") else x.dtype), value)
             else:
                 abstract[key] = jax.tree.map(
                     lambda x, s: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=s),

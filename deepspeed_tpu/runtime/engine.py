@@ -199,7 +199,10 @@ class DeepSpeedEngine:
             # the host-device boundary (reference stage_1_and_2.py:1189).
             from .zero.offload_host import HostOffloadOptimizer
             ratio = float(getattr(off_o, "ratio", 1.0))
-            host_tree = jax.device_get(self.module_params)
+            # host state is sharded: each process materializes only its
+            # addressable slices of the optimizer layout (reference shards
+            # CPU optimizer state per DP rank, stage_1_and_2.py:1189)
+            host_tree = self._to_opt_layout(self.module_params)
             if ratio < 1.0:
                 # Twin-Flow (ZeRO-Offload++, blogs/deepspeed-offloadpp):
                 # only `ratio` of the optimizer state lives on host; the
@@ -210,7 +213,7 @@ class DeepSpeedEngine:
                 host_masked = treedef.unflatten(
                     [p if m else None for p, m in zip(flat, mask)])
                 self._host_optimizer = HostOffloadOptimizer(
-                    self.optimizer.hyper, host_masked,
+                    self.optimizer.hyper, host_masked, self._opt_param_shardings,
                     gradient_clipping=float(self._config.gradient_clipping or 0.0))
                 dev_flat = jax.tree.leaves(self.module_params)
                 dev_masked = treedef.unflatten(
@@ -227,11 +230,14 @@ class DeepSpeedEngine:
                     "rest updated on device", ranks=[0])
             else:
                 self._host_optimizer = HostOffloadOptimizer(
-                    self.optimizer.hyper, host_tree,
+                    self.optimizer.hyper, host_tree, self._opt_param_shardings,
                     gradient_clipping=float(self._config.gradient_clipping or 0.0))
-                log_dist("ZeRO-Offload: native host CPUAdam in the step loop",
-                         ranks=[0])
-            self.opt_state = self._host_optimizer.state
+                log_dist("ZeRO-Offload: native host CPUAdam in the step loop "
+                         f"({self._host_optimizer.local_element_count():,} "
+                         "master elements on this process)", ranks=[0])
+            # host-offloaded state lives inside _host_optimizer (sharded
+            # per process); snapshot via _host_optimizer.state_dict()
+            self.opt_state = None
         else:
             with self.mesh:
                 self.opt_state = jax.jit(self.optimizer.init,
@@ -412,6 +418,35 @@ class DeepSpeedEngine:
             shardings = jax.tree.map(lambda s: s.with_memory_kind(kind), shardings,
                                      is_leaf=lambda x: isinstance(x, NamedSharding))
         return shardings
+
+    def _reshard_tree(self, tree, target_shardings):
+        """Compiled-identity reshard of a param-shaped tree (the ZeRO-Offload
+        staging allgather/slice; rides ICI). Trees with None leaves (Twin-Flow
+        halves) pass through. The jitted identity is memoized per (treedef,
+        shardings) — a fresh jax.jit each step would retrace and recompile in
+        the hot path."""
+        shardings = jax.tree.map(
+            lambda p, s: None if p is None else s, tree, target_shardings,
+            is_leaf=lambda x: x is None)
+        leaves, treedef = jax.tree.flatten(shardings)
+        key = (treedef, tuple(leaves))
+        cache = getattr(self, "_reshard_fns", None)
+        if cache is None:
+            cache = self._reshard_fns = {}
+        fn = cache.get(key)
+        if fn is None:
+            fn = cache[key] = jax.jit(lambda t: t, out_shardings=shardings)
+        with self.mesh:
+            return fn(tree)
+
+    def _to_opt_layout(self, param_tree):
+        """Reshard params into the optimizer layout (each rank's slice)."""
+        return self._reshard_tree(param_tree, self._opt_param_shardings)
+
+    def _to_param_layout(self, tree):
+        """Reshard optimizer-layout arrays back to the training param layout
+        (the ZeRO-Offload re-staging allgather)."""
+        return self._reshard_tree(tree, self.param_shardings)
 
     def _host_memory_kind(self):
         # Only meaningful on a real accelerator: on the CPU backend all
@@ -805,7 +840,10 @@ class DeepSpeedEngine:
 
         @functools.partial(
             jax.jit, static_argnames=("gas",),
-            out_shardings=(self._replicated, self.grad_shardings, self._replicated))
+            # grads leave the step in the OPTIMIZER layout: the host update
+            # reads exactly the local shard, never a replicated fetch
+            out_shardings=(self._replicated, self._opt_param_shardings,
+                           self._replicated))
         def grad_accum_fn(params, batch, scale, gas):
             if gas == 1:
                 mb = jax.tree.map(lambda x: x[0], batch)
@@ -865,18 +903,16 @@ class DeepSpeedEngine:
             unscaled_gsq = gsq_f / (divisor * divisor)
             grad_norm = unscaled_gsq ** 0.5
             if tf is None:
-                g_host = jax.tree.map(np.asarray, acc)
                 new_params = self._host_optimizer.step(
-                    g_host, grad_divisor=divisor, lr=lr,
+                    acc, grad_divisor=divisor, lr=lr,
                     grad_norm_sq=unscaled_gsq)
-                self.module_params = jax.device_put(new_params, self.param_shardings)
+                self.module_params = self._to_param_layout(new_params)
             else:
                 treedef = tf["treedef"]
                 flat_g = jax.tree.leaves(acc)
                 flat_p = jax.tree.leaves(self.module_params)
-                flat_sh = treedef.flatten_up_to(self.param_shardings)
                 host_g = treedef.unflatten(
-                    [np.asarray(g) if m else None for g, m in zip(flat_g, mask)])
+                    [g if m else None for g, m in zip(flat_g, mask)])
                 # device half first — it runs async while CPUAdam works
                 scale_inv = 1.0 / divisor
                 clip = float(self._config.gradient_clipping or 0.0)
@@ -889,14 +925,13 @@ class DeepSpeedEngine:
                 new_dev_p, tf["dev_state"] = self._twinflow_update_fn(
                     dev_p, tf["dev_state"], dev_g, jnp.float32(lr),
                     jnp.float32(scale_inv))
-                new_host = self._host_optimizer.step(
+                new_host = self._to_param_layout(self._host_optimizer.step(
                     host_g, grad_divisor=divisor, lr=lr,
-                    grad_norm_sq=unscaled_gsq)
+                    grad_norm_sq=unscaled_gsq))
                 host_it = iter(jax.tree.leaves(new_host))
                 dev_it = iter(jax.tree.leaves(new_dev_p))
-                flat_new = [
-                    jax.device_put(next(host_it), sh) if m else next(dev_it)
-                    for m, sh in zip(mask, flat_sh)]
+                flat_new = [next(host_it) if m else next(dev_it)
+                            for m in mask]
                 self.module_params = treedef.unflatten(flat_new)
         self._last_grad_norm = grad_norm
         self.micro_steps += gas
@@ -1077,7 +1112,7 @@ class DeepSpeedEngine:
                 is_leaf=lambda x: isinstance(x, dict) and ("m" in x or "master" in x))
 
         if self._host_optimizer is not None:
-            host = jax.device_get(self.module_params)
+            host = self._to_opt_layout(self.module_params)
             if self._twinflow is not None:
                 tdef, mask = self._twinflow["treedef"], self._twinflow["mask"]
                 flat = jax.tree.leaves(host)
@@ -1414,7 +1449,11 @@ class DeepSpeedEngine:
         self._swap_in_opt_state()
         state = {
             "module": self.module_params,
-            "optimizer": self.opt_state,
+            # host offload: assemble the sharded host state into global
+            # arrays (each process contributes its slices)
+            "optimizer": (self._host_optimizer.state_dict()
+                          if self._host_optimizer is not None
+                          else self.opt_state),
             **({"twinflow_device": self._twinflow["dev_state"]}
                if self._twinflow is not None else {}),
             "scaler": self.scaler_state._asdict(),
@@ -1462,9 +1501,9 @@ class DeepSpeedEngine:
             return path, meta.get("client_state", {})
         template = {
             "module": (self.module_params, self.param_shardings),
-            "optimizer": (self.opt_state,
-                          None if self._host_optimizer is not None
-                          else self.opt_state_shardings),
+            "optimizer": ((self._host_optimizer.abstract_state_dict(), None)
+                          if self._host_optimizer is not None
+                          else (self.opt_state, self.opt_state_shardings)),
             **({"twinflow_device": (self._twinflow["dev_state"], None)}
                if self._twinflow is not None else {}),
             "scaler": (self.scaler_state._asdict(), None),
@@ -1476,22 +1515,20 @@ class DeepSpeedEngine:
         if load_optimizer_states:
             if self._host_optimizer is not None:
                 self._host_optimizer.load_state_dict(state["optimizer"])
-                self.opt_state = self._host_optimizer.state
                 if self._twinflow is not None:
                     self._twinflow["dev_state"] = state["twinflow_device"]
                     # host masters overwrite only the host-owned leaves; the
                     # device half came in with state["module"]
                     tdef, mask = self._twinflow["treedef"], self._twinflow["mask"]
                     flat_p = jax.tree.leaves(self.module_params)
-                    flat_sh = tdef.flatten_up_to(self.param_shardings)
-                    host_it = iter(jax.tree.leaves(self._host_optimizer.params()))
-                    flat_new = [
-                        jax.device_put(next(host_it), sh) if m else p
-                        for p, m, sh in zip(flat_p, mask, flat_sh)]
+                    host_half = self._to_param_layout(self._host_optimizer.params())
+                    host_it = iter(jax.tree.leaves(host_half))
+                    flat_new = [next(host_it) if m else p
+                                for p, m in zip(flat_p, mask)]
                     self.module_params = tdef.unflatten(flat_new)
                 else:
-                    self.module_params = jax.device_put(
-                        self._host_optimizer.params(), self.param_shardings)
+                    self.module_params = self._to_param_layout(
+                        self._host_optimizer.params())
             else:
                 self.opt_state = state["optimizer"]
         self.scaler_state = LossScaleState(**{

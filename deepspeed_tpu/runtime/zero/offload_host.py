@@ -6,13 +6,24 @@ tensors → fp16 params re-staged to device). The compiled step computes and
 accumulates gradients on the accelerator; this class owns the fp32 master
 weights and Adam moments as host numpy arrays and updates them with the
 native AVX/OpenMP kernel (``ops/csrc/adam/cpu_adam.cpp`` via ctypes), then
-returns the low-precision param tree to re-stage on device.
+returns the param tree to re-stage on device.
+
+Host state is SHARDED: each process materializes only the slices of the
+optimizer layout (the engine's ``_opt_param_shardings`` — ZeRO's per-leaf
+partition over the data axes) that live on its addressable devices, exactly
+as the reference shards CPU optimizer state per DP rank
+(``stage_1_and_2.py:1189``). Gradients arrive as global ``jax.Array``s in
+that same layout, so only the local shard ever crosses the device→host
+boundary; updated params go back as global arrays assembled from the local
+slices (``jax.make_array_from_single_device_arrays``), and the engine's
+compiled reshard turns them into the training layout (the cross-process
+allgather rides ICI there). Replicated (sub-)axes mean several devices carry
+the same slice — those are deduplicated so each process updates each
+distinct slice once.
 
 State layout matches the device optimizers ({"step", "slots": {m, v,
-master}}), so checkpoint save/load round-trips through the same engine
-paths. Single-host semantics: grads are fetched as full (replicated)
-arrays; per-rank sharded host state is a multi-process concern
-(``jax.distributed``) out of scope here.
+master}}) at the ``state_dict()`` boundary (global arrays), so checkpoint
+save/load round-trips through the same engine paths.
 """
 
 import math
@@ -24,83 +35,227 @@ import numpy as np
 from .infinity import _HostAdam
 
 
-class HostOffloadOptimizer:
-    """fp32 master + moments on host, native CPUAdam update, cast-out params."""
+def _norm_index(index, shape):
+    """Normalize a shard index (tuple of slices) to a hashable key."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
 
-    def __init__(self, hyper: Dict[str, Any], param_tree, *,
+
+def _is_slot_leaf(x):
+    return isinstance(x, dict) and "master" in x
+
+
+class HostOffloadOptimizer:
+    """fp32 master + moments on host (local shards), native CPUAdam update."""
+
+    def __init__(self, hyper: Dict[str, Any], param_tree, shardings, *,
                  gradient_clipping: float = 0.0):
+        """``param_tree``: module params as (global) jax Arrays ALREADY in the
+        optimizer layout; ``shardings``: the matching NamedSharding tree.
+        Leaves may be None (Twin-Flow keeps those on device)."""
         self.adam = _HostAdam(hyper)
         self.hyper = dict(hyper)
         self.gradient_clipping = float(gradient_clipping or 0.0)
-        host_p = jax.tree.map(lambda x: np.asarray(x, np.float32), param_tree)
-        self._dtypes = jax.tree.map(lambda x: x.dtype, param_tree)
-        self.state = {
-            "step": np.zeros((), np.int32),
-            "slots": jax.tree.map(
-                lambda p: {"m": np.zeros_like(p), "v": np.zeros_like(p),
-                           "master": p}, host_p,
-                is_leaf=lambda x: isinstance(x, np.ndarray)),
-        }
 
-    def step(self, host_grads, *, grad_divisor: float = 1.0,
+        flat_p, self._treedef = jax.tree.flatten(
+            param_tree, is_leaf=lambda x: x is None)
+        flat_sh = self._treedef.flatten_up_to(shardings)
+        self._leaves = []
+        for p, sh in zip(flat_p, flat_sh):
+            if p is None:
+                self._leaves.append(None)
+                continue
+            slices = {}
+            device_keys = []
+            for shard in p.addressable_shards:
+                key = _norm_index(shard.index, p.shape)
+                device_keys.append((shard.device, key, shard.index))
+                if key not in slices:
+                    master = np.array(shard.data, np.float32)
+                    slices[key] = {"master": master,
+                                   "m": np.zeros_like(master),
+                                   "v": np.zeros_like(master)}
+            self._leaves.append({
+                "shape": tuple(p.shape),
+                "dtype": np.dtype(p.dtype),
+                "sharding": sh,
+                "devices": device_keys,   # (device, key, index) per shard
+                "slices": slices,
+            })
+        self._step = 0
+
+    def _assemble(self, leaf, field, dtype):
+        """Global jax.Array in the optimizer layout from the local slices."""
+        arrays = [
+            jax.device_put(np.ascontiguousarray(
+                leaf["slices"][key][field].astype(dtype, copy=False)), dev)
+            for dev, key, _ in leaf["devices"]]
+        return jax.make_array_from_single_device_arrays(
+            leaf["shape"], leaf["sharding"], arrays)
+
+    def _assemble_host(self, leaf, field):
+        """Full numpy array from the local slices (single-process only —
+        every slice of the leaf is local, so no device round-trip)."""
+        out = np.empty(leaf["shape"], np.float32)
+        for key, s in leaf["slices"].items():
+            out[tuple(slice(a, b) for a, b in key)] = s[field]
+        return out
+
+    def step(self, grads, *, grad_divisor: float = 1.0,
              lr: Optional[float] = None,
              grad_norm_sq: Optional[float] = None) -> Any:
-        """Update masters in place from host fp32 grads; returns the new
-        param tree in the original (possibly low-precision) dtypes.
+        """Update masters in place from grads (global jax Arrays in the
+        optimizer layout); returns the new param tree as global arrays in
+        that layout and the original training dtypes.
 
         ``grad_divisor`` folds loss-scale × gradient-accumulation unscaling
         into the same pass as clipping. ``grad_norm_sq`` is the UNSCALED
-        global grad norm squared if the caller computed it on device;
-        otherwise it is computed here.
+        global grad norm squared — the engine computes it on device where the
+        cross-process reduction is free; without it, clipping falls back to a
+        process-local norm, which is only correct single-process.
         """
-        step_num = int(self.state["step"]) + 1
-        self.state["step"] = np.asarray(step_num, np.int32)
-        flat_g = jax.tree.leaves(host_grads)
-        flat_s = jax.tree.leaves(self.state["slots"],
-                                 is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+        self._step += 1
+        flat_g = self._treedef.flatten_up_to(grads)
         scale = 1.0 / grad_divisor
+        local_g = []   # per leaf: {key: np grad slice}
+        for g, lf in zip(flat_g, self._leaves):
+            if lf is None:
+                local_g.append(None)
+                continue
+            by_key = {}
+            for shard in g.addressable_shards:
+                key = _norm_index(shard.index, g.shape)
+                if key in lf["slices"] and key not in by_key:
+                    by_key[key] = shard.data
+            if set(by_key) != set(lf["slices"]):
+                # layout drift between the grad out_shardings and the host
+                # state would otherwise train silently wrong (stale slices)
+                raise ValueError(
+                    f"gradient layout does not cover the host optimizer "
+                    f"shard set for a leaf of shape {lf['shape']}: got "
+                    f"{sorted(by_key)}, hold {sorted(lf['slices'])}")
+            local_g.append(by_key)
         if self.gradient_clipping > 0.0:
             if grad_norm_sq is None:
-                grad_norm_sq = sum(float(np.vdot(g, g)) for g in flat_g) * scale * scale
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "multi-process host offload needs the device-computed "
+                        "global grad norm (grad_norm_sq); a host-local norm "
+                        "would clip each rank differently")
+                grad_norm_sq = sum(
+                    float(np.vdot(g, g)) for by_key in local_g if by_key
+                    for g in by_key.values()) * scale * scale
             gnorm = math.sqrt(grad_norm_sq)
             scale *= min(1.0, self.gradient_clipping / (gnorm + 1e-6))
-        for g, s in zip(flat_g, flat_s):
-            gh = np.asarray(g, dtype=np.float32)
-            if scale != 1.0:
-                gh = gh * scale          # also makes a writable copy
-            elif not gh.flags.writeable or not gh.flags.c_contiguous:
-                gh = np.array(gh)        # jax host views are read-only
-            self.adam.step(s["master"], gh, s["m"], s["v"], step_num, lr)
+        for by_key, lf in zip(local_g, self._leaves):
+            if lf is None:
+                continue
+            for key, g in by_key.items():
+                gh = np.asarray(g, dtype=np.float32)
+                if scale != 1.0:
+                    gh = gh * scale          # also makes a writable copy
+                elif not gh.flags.writeable or not gh.flags.c_contiguous:
+                    gh = np.array(gh)        # jax host views are read-only
+                s = lf["slices"][key]
+                self.adam.step(s["master"], gh, s["m"], s["v"], self._step, lr)
         return self.params()
 
     def reset_masters(self, param_tree):
-        """Overwrite the fp32 masters in place from new module weights
-        (moments kept) — the sync the engine needs when weights are loaded
-        outside the checkpoint path, since every future update starts from
-        the masters, not the device params."""
-        def upd(s, p):
-            # fresh writable buffer: device_get views are read-only
-            s["master"] = np.array(p, np.float32)
-            return s
-        jax.tree.map(upd, self.state["slots"], param_tree,
-                     is_leaf=lambda x: isinstance(x, dict) and "master" in x)
+        """Overwrite the fp32 masters in place from new module weights in
+        the optimizer layout (moments kept) — the sync the engine needs when
+        weights are loaded outside the checkpoint path, since every future
+        update starts from the masters, not the device params."""
+        flat_p = self._treedef.flatten_up_to(param_tree)
+        for p, lf in zip(flat_p, self._leaves):
+            if lf is None:
+                continue
+            seen = set()
+            for shard in p.addressable_shards:
+                key = _norm_index(shard.index, p.shape)
+                if key in lf["slices"] and key not in seen:
+                    seen.add(key)
+                    lf["slices"][key]["master"] = np.array(shard.data, np.float32)
+            if seen != set(lf["slices"]):
+                raise ValueError(
+                    f"param layout does not cover the host master shard set "
+                    f"for a leaf of shape {lf['shape']}: got {sorted(seen)}, "
+                    f"hold {sorted(lf['slices'])}")
 
     def params(self):
-        """Current params cast back to their training dtypes (host arrays)."""
-        masters = jax.tree.map(
-            lambda s: s["master"], self.state["slots"],
-            is_leaf=lambda x: isinstance(x, dict) and "master" in x)
-        return jax.tree.map(lambda p, dt: p.astype(dt) if dt != np.float32 else p,
-                            masters, self._dtypes)
+        """Current params in their training dtypes (global arrays, optimizer
+        layout — the engine reshards to the training layout on device)."""
+        return self._treedef.unflatten([
+            None if lf is None else self._assemble(lf, "master", lf["dtype"])
+            for lf in self._leaves])
+
+    def local_element_count(self) -> int:
+        """Distinct optimizer-state elements materialized on THIS process
+        (x3 for master/m/v) — the multi-process tests assert disjointness."""
+        return sum(s["master"].size for lf in self._leaves if lf
+                   for s in lf["slices"].values())
 
     # ---- checkpoint interop (same structure as device optimizers) ----
 
     def state_dict(self):
-        return self.state
+        """Snapshot in the device-optimizer structure: {"step", "slots":
+        {m, v, master}}. Single-process: plain numpy (host-only — no device
+        memory touched). Multi-process: global jax.Arrays in the optimizer
+        layout (each process contributes its shards; orbax handles the
+        distributed write). NOTE the multi-process path transiently stages
+        the local 3x-fp32 opt shard through device memory — bounded by the
+        shard, not the model, but still a save-time HBM spike."""
+        if jax.process_count() == 1:
+            slots = self._treedef.unflatten([
+                None if lf is None else {
+                    f: self._assemble_host(lf, f) for f in ("master", "m", "v")}
+                for lf in self._leaves])
+        else:
+            slots = self._treedef.unflatten([
+                None if lf is None else {
+                    f: self._assemble(lf, f, np.float32)
+                    for f in ("master", "m", "v")}
+                for lf in self._leaves])
+        return {"step": np.asarray(self._step, np.int32), "slots": slots}
+
+    def abstract_state_dict(self):
+        """state_dict() structure as ShapeDtypeStructs (checkpoint-restore
+        template) — avoids materializing 3x fp32 model size on device just to
+        describe the tree."""
+        slots = self._treedef.unflatten([
+            None if lf is None else {
+                f: jax.ShapeDtypeStruct(lf["shape"], np.float32,
+                                        sharding=lf["sharding"])
+                for f in ("master", "m", "v")}
+            for lf in self._leaves])
+        return {"step": np.asarray(self._step, np.int32), "slots": slots}
 
     def load_state_dict(self, sd):
-        self.state = {
-            "step": np.asarray(jax.device_get(sd["step"]), np.int32),
-            "slots": jax.tree.map(lambda x: np.asarray(jax.device_get(x), np.float32),
-                                  sd["slots"]),
-        }
+        self._step = int(np.asarray(jax.device_get(sd["step"])))
+        flat_slots = self._treedef.flatten_up_to(sd["slots"])
+        for slot, lf in zip(flat_slots, self._leaves):
+            if lf is None or slot is None:
+                continue
+            for f in ("master", "m", "v"):
+                arr = slot[f]
+                if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+                    seen = set()
+                    for shard in arr.addressable_shards:
+                        key = _norm_index(shard.index, lf["shape"])
+                        if key in lf["slices"]:
+                            seen.add(key)
+                            lf["slices"][key][f] = np.array(shard.data, np.float32)
+                    if seen != set(lf["slices"]):
+                        raise ValueError(
+                            f"checkpoint layout does not cover the host "
+                            f"optimizer shard set for a leaf of shape "
+                            f"{lf['shape']}: got {sorted(seen)}, hold "
+                            f"{sorted(lf['slices'])}")
+                else:
+                    full = np.asarray(jax.device_get(arr), np.float32)
+                    for key, s in lf["slices"].items():
+                        idx = tuple(slice(a, b) for a, b in key)
+                        s[f] = np.ascontiguousarray(full[idx])
