@@ -41,11 +41,6 @@ class PagedModelRunner:
                 "the paged serving runner executes causal pre-norm decoder "
                 "blocks; BERT-style encoders are not autoregressive — serve "
                 "them with InferenceEngine (v1) forward passes")
-        if model._groups is not None:
-            raise NotImplementedError(
-                "heterogeneous layer stacks (cfg.layer_types) are not yet "
-                "threaded through the paged runner's layer scan; serve via "
-                "InferenceEngine (v1) generate")
         self.model = model
         self.cfg = model.cfg
         self.block_size = block_size
@@ -96,7 +91,7 @@ class PagedModelRunner:
                 and cfg.sliding_window < block_tables.shape[1] * bs:
             uniform_window = cfg.sliding_window   # binds within this pool
 
-        def layer(h, xs):
+        def layer(h, xs, tag=None):
             lp, kp, vp, win = xs
             if win is None:
                 win = uniform_window
@@ -149,7 +144,7 @@ class PagedModelRunner:
             else:
                 h = h + y
                 m_in = L.apply_norm(lp["norm2"], h, cfg)
-            if cfg.is_moe:
+            if cfg.is_moe if tag is None else tag == "moe":   # group tag overrides
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
@@ -159,9 +154,60 @@ class PagedModelRunner:
                 return h + y + mlp_out, (kp, vp)
             return h + mlp_out, (kp, vp)
 
-        h, (kpool, vpool) = jax.lax.scan(layer, h, (params["layers"], kpool, vpool,
-                                                    windows))
+        h, kpool, vpool = self._run_layers(layer, h, params, kpool, vpool, windows)
         h = L.apply_norm(params["final_norm"], h, cfg)
+        return self._head(params, h, valid_counts), kpool, vpool
+
+    def _run_layers(self, layer, h, params, kpool, vpool, windows):
+        """Drive ``layer`` over the stack following the model's layer plan
+        (mirrors ``models/transformer.py hidden_states``): one scan when
+        homogeneous; heterogeneous stacks (cfg.layer_types — Qwen2-MoE
+        sparse steps, mlp_only prefixes) run the periodic super-layer scan or
+        one scan per contiguous segment, with the KV pools' layer axis
+        sliced to match the grouped param layout."""
+        model = self.model
+        if model._groups is None:
+            h, (kpool, vpool) = jax.lax.scan(
+                layer, h, (params["layers"], kpool, vpool, windows))
+            return h, kpool, vpool
+        if model._plan[0] == "periodic":
+            p = model._plan[1]
+            n_super = self.cfg.num_layers // p
+            kp_rs = kpool.reshape((n_super, p) + kpool.shape[1:])
+            vp_rs = vpool.reshape((n_super, p) + vpool.shape[1:])
+            win_rs = None if windows is None else windows.reshape(-1, p)
+
+            def super_layer(h, xs):
+                groups_t, kp_t, vp_t, win_t = xs
+                kp_out, vp_out = [], []
+                for j, (tag, _) in enumerate(model._groups):
+                    w_j = None if win_t is None else win_t[j]
+                    h, (kp_j, vp_j) = layer(
+                        h, (groups_t[f"g{j}"], kp_t[j], vp_t[j], w_j), tag=tag)
+                    kp_out.append(kp_j)
+                    vp_out.append(vp_j)
+                return h, (jnp.stack(kp_out), jnp.stack(vp_out))
+
+            h, (kp_rs, vp_rs) = jax.lax.scan(
+                super_layer, h, (params["layers"], kp_rs, vp_rs, win_rs))
+            return (h, kp_rs.reshape(kpool.shape), vp_rs.reshape(vpool.shape))
+        # contiguous segments: one scan per run; pool slices re-concatenated
+        kp_parts, vp_parts = [], []
+        for gi, (tag, idxs) in enumerate(model._groups):
+            lo, n = idxs[0], len(idxs)
+            win_seg = None if windows is None else windows[lo:lo + n]
+            h, (kp_g, vp_g) = jax.lax.scan(
+                functools.partial(layer, tag=tag), h,
+                (params["layers"][f"g{gi}"], kpool[lo:lo + n],
+                 vpool[lo:lo + n], win_seg))
+            kp_parts.append(kp_g)
+            vp_parts.append(vp_g)
+        return (h, jnp.concatenate(kp_parts), jnp.concatenate(vp_parts))
+
+    def _head(self, params, h, valid_counts):
+        """Last-valid-token logits (B, V) from normed hidden states."""
+        cfg = self.cfg
+        dt = cfg.act_dtype
         # last valid token of each chunk
         last_idx = jnp.maximum(valid_counts - 1, 0)
         h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
@@ -173,7 +219,7 @@ class PagedModelRunner:
             logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
         if cfg.logit_softcap:
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
-        return logits.astype(jnp.float32), kpool, vpool
+        return logits.astype(jnp.float32)
 
     def _build_decode_loop(self):
         fwd = self._forward

@@ -41,10 +41,13 @@ def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
     - ``stacked_layer_params``: pytree with leading dim L = P * layers_per_stage,
       sharded over "pipe" on dim 0.
     - ``stream``: (M, ...) microbatch activations, replicated over "pipe".
-    - ``layer_fn(layer_params, x) -> y`` single-layer forward (x, y same shape).
+    - ``layer_fn(layer_params, x) -> (y, aux)`` single-layer forward (x, y
+      same shape; aux = scalar MoE router loss, zero for dense layers).
 
-    Returns outputs (M, ...) — the last stage's results, replicated over
-    "pipe" (via masked psum).
+    Returns (outputs (M, ...), aux_total) — the last stage's results and the
+    summed per-layer aux over all real microbatches, both replicated over
+    "pipe" (via masked psum). Fill/drain ticks compute on garbage
+    activations; their aux is masked out.
     """
     mesh = groups.get_mesh()
 
@@ -55,20 +58,26 @@ def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
         ticks = m + num_stages - 1
 
         def run_stage(layers_params, x):
-            def one(h, lp):
-                return layer_fn(lp, h), None
-            y, _ = jax.lax.scan(one, x, layers_params)
-            return y
+            def one(carry, lp):
+                h, aux = carry
+                h, a = layer_fn(lp, h)
+                return (h, aux + a), None
+            (y, aux), _ = jax.lax.scan(
+                one, (x, jnp.zeros((), jnp.float32)), layers_params)
+            return y, aux
 
         if remat:
             run_stage = jax.checkpoint(run_stage)
 
         def tick(carry, t):
-            act, buf = carry
+            act, buf, aux_acc = carry
             mb_idx = jnp.clip(t, 0, m - 1)
             x_new = jax.lax.dynamic_index_in_dim(stream, mb_idx, axis=0, keepdims=False)
             x = jnp.where(stage == 0, _pvary(x_new, "pipe"), act)
-            y = run_stage(stage_layers, x)
+            y, aux = run_stage(stage_layers, x)
+            # stage s holds real microbatch (t - s) only inside the window
+            valid = (t >= stage) & (t - stage < m)
+            aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
             out_idx = jnp.clip(t - (num_stages - 1), 0, m - 1)
             is_out = (stage == num_stages - 1) & (t >= num_stages - 1)
             cur = jax.lax.dynamic_index_in_dim(buf, out_idx, axis=0, keepdims=False)
@@ -76,21 +85,24 @@ def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
             buf = jax.lax.dynamic_update_index_in_dim(buf, upd, out_idx, axis=0)
             perm = [(i, (i + 1) % num_stages) for i in range(num_stages)]
             act_next = jax.lax.ppermute(y, "pipe", perm)
-            return (act_next, buf), None
+            return (act_next, buf, aux_acc), None
 
         act0 = jnp.zeros(stream.shape[1:], stream.dtype)
         act0 = _pvary(act0, "pipe")
         buf0 = _pvary(jnp.zeros_like(stream), "pipe")
-        (act, buf), _ = jax.lax.scan(tick, (act0, buf0), jnp.arange(ticks))
+        aux0 = _pvary(jnp.zeros((), jnp.float32), "pipe")
+        (act, buf, aux_acc), _ = jax.lax.scan(
+            tick, (act0, buf0, aux0), jnp.arange(ticks))
         # replicate last stage's buffer to every stage
         mask = (stage == num_stages - 1).astype(buf.dtype)
-        return jax.lax.psum(buf * mask, "pipe")
+        return (jax.lax.psum(buf * mask, "pipe"),
+                jax.lax.psum(aux_acc, "pipe"))
 
     # manual over pipe only; data/tensor/... axes stay automatic (handled by
     # the outer jit shardings).
     return jax.shard_map(per_stage, mesh=mesh,
                          in_specs=(P("pipe"), P()),
-                         out_specs=P(),
+                         out_specs=(P(), P()),
                          axis_names={"pipe"},
                          check_vma=True)
 
@@ -109,28 +121,31 @@ def check_pipeline_model_support(cfg):
         raise NotImplementedError(
             "per-layer local/global attention patterns are not threaded "
             "through pipeline stages; uniform sliding_window is supported")
-    if getattr(cfg, "layer_types", None) and len(set(cfg.layer_types)) > 1:
-        raise NotImplementedError(
-            "heterogeneous layer stacks (cfg.layer_types) cannot be "
-            "partitioned into uniform pipeline stages yet; train them under "
-            "ZeRO (DP/TP/SP/EP) instead")
+    # heterogeneous stacks (cfg.layer_types) are supported by the 1F1B
+    # engine via per-stage slot tables (see build_pipeline_1f1b); the GPipe
+    # autodiff path keeps its own guard in build_pipeline_loss.
 
 
 def _pipeline_interface(model):
     """Three-segment protocol a model must satisfy to be pipelined:
-    ``embed(other_params, batch_mb) -> h``, ``layer(layer_params, h) -> h``,
-    ``loss(other_params, h, batch_mb) -> scalar``, with params split as
-    {"layers": stacked-L pytree, **other}. Models may provide
+    ``embed(other_params, batch_mb) -> h``, ``layer(layer_params, h) ->
+    (h, aux_loss)``, ``loss(other_params, h, batch_mb) -> scalar``, with
+    params split as {"layers": stacked-L pytree, **other}. Models may provide
     ``pipe_embed/pipe_layer/pipe_loss`` directly; CausalLM is adapted from
-    its ``embed_fwd/_layer_fn/head_loss``."""
+    its ``embed_fwd/_layer_fn/head_loss``. The per-layer aux (MoE router
+    load balancing) is accumulated on each stage and folded into the loss."""
     if hasattr(model, "pipe_embed"):
-        return model.pipe_embed, model.pipe_layer, model.pipe_loss
+        raw = model.pipe_layer
+
+        def custom_layer(lp, h, tag=None):   # tag unused; no aux loss in
+            return raw(lp, h), jnp.zeros((), jnp.float32)   # custom protocol
+        return model.pipe_embed, custom_layer, model.pipe_loss
 
     def embed(other, batch_mb):
         return model.embed_fwd(other["embed"], batch_mb["input_ids"])
 
-    def layer(lp, h):
-        return model._layer_fn(lp, h, None, None)[0]
+    def layer(lp, h, tag=None):
+        return model._layer_fn(lp, h, None, None, layer_type=tag)
 
     def loss(other, h, batch_mb):
         return model.head_loss(other, h, batch_mb["labels"],
@@ -171,7 +186,56 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
     mesh = groups.get_mesh()
     embed_fn, layer_fn, loss_fn = _pipeline_interface(model)
     if remat:
-        layer_fn = jax.checkpoint(layer_fn)
+        layer_fn = jax.checkpoint(layer_fn, static_argnums=(2,))
+
+    # MoE router aux weight per aux-emitting layer (CausalLM.loss adds
+    # coef * aux_total / n_moe; stages each contribute their layers' share)
+    aux_coef = 0.0
+    if hasattr(model, "cfg") and getattr(model.cfg, "is_moe", False):
+        n_moe = sum(1 for i in range(model.cfg.num_layers)
+                    if model.cfg.layer_type(i) == "moe") or 1
+        aux_coef = float(model.cfg.moe_aux_loss_coef) / n_moe
+
+    # ---- heterogeneous stacks: per-stage slot tables -------------------
+    # Stages stay contiguous slices of the ORIGINAL layer order (reference
+    # PipeModule partitions arbitrary LayerSpec lists, pipe/module.py:86).
+    # Since every stage runs the same SPMD program, per-layer type dispatch
+    # is a lax.switch on a (stage, slot) -> group table (the same per-device
+    # gating the embed/head lax.conds already use), and each group's stacked
+    # params are re-gathered into uniform per-stage blocks (padded with a
+    # duplicated member when a stage holds fewer of that group; pad slots
+    # are never selected by the table, so their grads are zero).
+    het = getattr(model, "_groups", None)
+    if het is not None:
+        import numpy as np
+        L_total = model.cfg.num_layers
+        if L_total % num_stages:
+            raise ValueError(
+                f"num_layers={L_total} not divisible by pipe={num_stages}")
+        per_stage = L_total // num_stages
+        where = {}
+        for gi, (tag, idxs) in enumerate(het):
+            for k, i in enumerate(idxs):
+                where[i] = (gi, k)
+        type_tab = np.zeros((num_stages, per_stage), np.int32)
+        slot_tab = np.zeros((num_stages, per_stage), np.int32)
+        group_perms = []
+        for s in range(num_stages):
+            cnt = [0] * len(het)
+            for t in range(per_stage):
+                gi, _ = where[s * per_stage + t]
+                type_tab[s, t] = gi
+                slot_tab[s, t] = cnt[gi]
+                cnt[gi] += 1
+        for gi, (tag, idxs) in enumerate(het):
+            members = [[where[i][1]
+                        for i in range(s * per_stage, (s + 1) * per_stage)
+                        if where[i][0] == gi] for s in range(num_stages)]
+            cmax = max(len(m) for m in members)
+            perm = []
+            for m in members:
+                perm.extend(m + [m[-1] if m else 0] * (cmax - len(m)))
+            group_perms.append(np.asarray(perm, np.int32))
 
     def step(params, batch, scale):
         m = jax.tree.leaves(batch)[0].shape[0]
@@ -199,22 +263,61 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
 
             def stage_fn(layers_p, other_pp, x, mb_idx):
                 """x: (mb, ...) incoming activation (ignored on stage 0).
-                Returns (y, per-mb loss). Embedding and head/loss are
-                ``lax.cond``-gated so middle stages execute neither (cond
-                runs — and differentiates — only the taken branch)."""
+                Returns (y, per-mb loss contribution: head CE on the last
+                stage + this stage's share of the MoE router aux). Embedding
+                and head/loss are ``lax.cond``-gated so middle stages execute
+                neither (cond runs — and differentiates — only the taken
+                branch)."""
                 bmb = batch_mb(mb_idx)
                 h = jax.lax.cond(
                     is_first,
                     lambda xx: embed_fn(other_pp, bmb).astype(xx.dtype),
                     lambda xx: xx, x)
 
-                def one(hh, lp):
-                    return layer_fn(lp, hh), None
-                h, _ = jax.lax.scan(one, h, layers_p)
+                aux0 = jnp.zeros((), jnp.float32)
+                if het is None:
+                    def one(carry, lp):
+                        hh, aux = carry
+                        hh, a = layer_fn(lp, hh, None)
+                        return (hh, aux + a), None
+                    (h, aux_sum), _ = jax.lax.scan(one, (h, aux0), layers_p)
+                else:
+                    # slot walk: switch on this stage's (type, local index)
+                    # tables — only the selected group's layer executes
+                    ttab = jax.lax.dynamic_index_in_dim(
+                        jnp.asarray(type_tab), stage, 0, keepdims=False)
+                    stab = jax.lax.dynamic_index_in_dim(
+                        jnp.asarray(slot_tab), stage, 0, keepdims=False)
+
+                    def branch(gi, tag):
+                        def b(args):
+                            hh, ix = args
+                            lp = jax.tree.map(
+                                lambda a: jax.lax.dynamic_index_in_dim(
+                                    a, ix, 0, keepdims=False),
+                                layers_p[f"g{gi}"])
+                            return layer_fn(lp, hh, tag)
+                        return b
+
+                    branches = [branch(gi, tag)
+                                for gi, (tag, _) in enumerate(het)]
+
+                    def one(carry, tt):
+                        hh, aux = carry
+                        ty, ix = tt
+                        hh, a = jax.lax.switch(ty, branches, (hh, ix))
+                        return (hh, aux + a), None
+                    (h, aux_sum), _ = jax.lax.scan(one, (h, aux0), (ttab, stab))
                 lss = jax.lax.cond(
                     is_last,
                     lambda hh: loss_fn(other_pp, hh, bmb).astype(jnp.float32),
                     lambda hh: jnp.zeros((), jnp.float32), h)
+                # fold this stage's router-aux share into its loss output so
+                # the explicit-vjp backward seeds it on every stage (the
+                # stage psum then reconstructs coef * aux_total / n_moe,
+                # matching CausalLM.loss)
+                if aux_coef:
+                    lss = lss + jnp.float32(aux_coef) * aux_sum
                 return h, lss
 
             # probe activation shape/dtype via eval_shape (embed output)
@@ -261,7 +364,9 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
 
                 def bwd_branch(_):
                     dy = jnp.where(is_last, jnp.zeros_like(gin), gin)
-                    dl = jnp.where(is_last, scale_ / m, 0.0).astype(jnp.float32)
+                    # every stage's loss output is seeded: the last stage's
+                    # carries the CE, every stage's carries its aux share
+                    dl = jnp.asarray(scale_ / m, jnp.float32)
 
                     def edge(_):
                         # first/last stage: embed or head params get grads
@@ -323,7 +428,8 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
             (x_buf, g_buf, acc_l, acc_o, loss_acc), _ = jax.lax.scan(
                 tick, carry0, (jnp.asarray(fwd_tab), jnp.asarray(bwd_tab)))
 
-            loss = jax.lax.psum(loss_acc, "pipe") / m     # only last stage nonzero
+            loss = jax.lax.psum(loss_acc, "pipe") / m     # last stage's CE +
+            # every stage's MoE router-aux share (zero for dense stacks)
             acc_o = jax.lax.psum(acc_o, "pipe")           # stage-0 embed + last head
             return loss, acc_l, acc_o
 
@@ -332,8 +438,26 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
                            out_specs=(P(), P("pipe"), P()),
                            axis_names={"pipe"},
                            check_vma=False)
+        layers_in = params["layers"]
+        if het is not None:
+            # regather each group's stack into uniform padded per-stage
+            # blocks so the leading axis shards P("pipe")
+            layers_in = {
+                f"g{gi}": jax.tree.map(
+                    lambda a, p=group_perms[gi]: jnp.take(a, p, axis=0),
+                    layers_in[f"g{gi}"])
+                for gi in range(len(het))}
         loss, grads_layers, grads_other = fn(
-            params["layers"], other, batch, jnp.asarray(scale, jnp.float32))
+            layers_in, other, batch, jnp.asarray(scale, jnp.float32))
+        if het is not None:
+            # scatter-add back to the original group layout (duplicated pad
+            # slots were never selected, so they contribute zero grads)
+            grads_layers = {
+                f"g{gi}": jax.tree.map(
+                    lambda g, o, p=group_perms[gi]:
+                        jnp.zeros(o.shape, g.dtype).at[p].add(g),
+                    grads_layers[f"g{gi}"], params["layers"][f"g{gi}"])
+                for gi in range(len(het))}
         grads = dict(grads_other)
         grads["layers"] = grads_layers
         return loss, grads
@@ -349,16 +473,22 @@ def build_pipeline_loss(model, num_stages: int):
     from ...models import layers as L
     cfg = model.cfg
     check_pipeline_model_support(cfg)
+    if getattr(model, "_groups", None) is not None:
+        raise NotImplementedError(
+            "heterogeneous layer stacks pipeline through the 1F1B engine "
+            "(pipeline.schedule='1f1b', the default), not the GPipe "
+            "autodiff path")
     assert cfg.num_layers % num_stages == 0, \
         f"num_layers={cfg.num_layers} not divisible by pipe={num_stages}"
     layers_per_stage = cfg.num_layers // num_stages
 
     def layer_fn(lp, h):
-        h, _ = model._layer_fn(lp, h, None, None)
-        return h
+        return model._layer_fn(lp, h, None, None)
 
     pipe_run = pipeline_spmd(layer_fn, num_stages, layers_per_stage,
                              remat=cfg.remat != "none")
+    n_moe = sum(1 for i in range(cfg.num_layers)
+                if cfg.layer_type(i) == "moe") or 1
 
     def loss_fn(params, batch):
         ids = batch["input_ids"]          # (M, mb, S)
@@ -370,7 +500,7 @@ def build_pipeline_loss(model, num_stages: int):
         h = model.embed_fwd(params["embed"], flat_ids)
         h = h.reshape(m, mb, s, cfg.hidden_size)
 
-        h = pipe_run(params["layers"], h)
+        h, aux_total = pipe_run(params["layers"], h)
 
         h = h.reshape(m * mb, s, cfg.hidden_size)
         h = L.apply_norm(params["final_norm"], h, cfg)
@@ -384,8 +514,14 @@ def build_pipeline_loss(model, num_stages: int):
         nll = -jnp.take_along_axis(logp, flat_labels[..., None], axis=-1)[..., 0]
         mask = batch.get("loss_mask")
         if mask is None:
-            return jnp.mean(nll)
-        mask = mask.reshape(m * mb, s)
-        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+            ce = jnp.mean(nll)
+        else:
+            mask = mask.reshape(m * mb, s)
+            ce = jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        if cfg.is_moe:
+            # aux_total sums every layer x microbatch; match CausalLM.loss's
+            # coef * (per-microbatch aux / n_moe), averaged over microbatches
+            ce = ce + cfg.moe_aux_loss_coef * aux_total / (n_moe * m)
+        return ce
 
     return loss_fn

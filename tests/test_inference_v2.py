@@ -180,3 +180,40 @@ def test_build_hf_engine_from_checkpoint_dir(tmp_path):
     v1 = ds.init_inference(hf, dtype="float32")
     ref = np.asarray(v1.generate(prompt, max_new_tokens=6))[0, 8:]
     np.testing.assert_array_equal(ref, out)
+
+
+def _het_cfg(layer_types):
+    from deepspeed_tpu.models.config import TransformerConfig
+    return TransformerConfig(
+        vocab_size=256, hidden_size=64, num_layers=len(layer_types),
+        num_heads=4, intermediate_size=128, max_seq_len=128, num_experts=2,
+        num_experts_per_tok=1, layer_types=tuple(layer_types),
+        dtype="float32", param_dtype="float32")
+
+
+@pytest.mark.parametrize("layer_types", [
+    ("dense", "moe", "dense", "moe"),   # Qwen2-MoE decoder_sparse_step (periodic)
+    ("dense", "dense", "moe", "moe"),   # mlp_only prefix (contiguous segments)
+])
+def test_ragged_heterogeneous_stack_matches_dense(layer_types):
+    """Heterogeneous stacks (cfg.layer_types) serve through the paged v2
+    runner (reference FastGen serves Qwen2-MoE sparse stacks,
+    ``inference/v2/model_implementations/qwen_v2_moe/model.py``): greedy
+    output must match the v1 dense-cache engine for both layer plans."""
+    model = build_model(_het_cfg(layer_types))
+    params = model.init(jax.random.PRNGKey(0))
+
+    v1 = ds.init_inference(model, dtype="float32")
+    v1.module_params = jax.device_put(params, v1.param_shardings)
+
+    cfg = RaggedInferenceEngineConfig(kv_block_size=16, prefill_chunk_size=32,
+                                      max_tokens_per_step=256, dtype="float32",
+                                      max_ragged_batch_size=8)
+    v2 = InferenceEngineV2(model, cfg, max_seq_len=128)
+    v2.params = jax.device_put(params)
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, 200, (1, 24))
+    dense = np.asarray(v1.generate(prompt, max_new_tokens=8))[0, 24:]
+    ragged = v2.generate([prompt[0]], max_new_tokens=8)[0]
+    np.testing.assert_array_equal(dense, ragged)

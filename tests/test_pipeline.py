@@ -207,3 +207,29 @@ def test_partition_method_validation():
         PipelineModule(model=m, num_stages=2, partition_method="bogus")
     with pytest.raises(NotImplementedError):
         PipelineModule(model=m, num_stages=2, partition_method="type:attn")
+
+
+@pytest.mark.parametrize("layer_types", [
+    ("dense", "moe", "dense", "moe"),   # periodic (Qwen2-MoE sparse step)
+    ("dense", "dense", "moe", "moe"),   # contiguous segments (mlp_only prefix)
+])
+def test_1f1b_heterogeneous_stack(layer_types):
+    """Heterogeneous stacks pipeline through 1F1B (reference PipeModule
+    partitions arbitrary LayerSpec lists, ``runtime/pipe/module.py:86``):
+    per-stage slot tables lax.switch each slot to its group's layer, and
+    grads must match plain autodiff on the grouped tree — including the MoE
+    router/expert grads."""
+    from deepspeed_tpu.models.config import TransformerConfig
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(pipe=2, data=4))
+    cfg = TransformerConfig(
+        vocab_size=256, hidden_size=64, num_layers=len(layer_types),
+        num_heads=4, intermediate_size=128, max_seq_len=128, num_experts=2,
+        num_experts_per_tok=1, layer_types=tuple(layer_types),
+        dtype="float32", param_dtype="float32")
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 256, (4, 2, 16)))
+    _pipe_1f1b_vs_ref(model, params, {"input_ids": ids, "labels": ids}, 2,
+                      rtol=2e-2, atol=2e-4)
