@@ -160,49 +160,22 @@ class PagedModelRunner:
 
     def _run_layers(self, layer, h, params, kpool, vpool, windows):
         """Drive ``layer`` over the stack following the model's layer plan
-        (mirrors ``models/transformer.py hidden_states``): one scan when
-        homogeneous; heterogeneous stacks (cfg.layer_types — Qwen2-MoE
-        sparse steps, mlp_only prefixes) run the periodic super-layer scan or
-        one scan per contiguous segment, with the KV pools' layer axis
-        sliced to match the grouped param layout."""
+        (heterogeneous stacks: Qwen2-MoE sparse steps, mlp_only prefixes),
+        with the KV pools' layer axis sliced to match the grouped param
+        layout. The plan walk itself lives in
+        ``models/transformer.py walk_layer_plan`` — shared with the train
+        forward and the cached decode so the three paths cannot diverge."""
+        from ...models.transformer import walk_layer_plan
         model = self.model
-        if model._groups is None:
-            h, (kpool, vpool) = jax.lax.scan(
-                layer, h, (params["layers"], kpool, vpool, windows))
-            return h, kpool, vpool
-        if model._plan[0] == "periodic":
-            p = model._plan[1]
-            n_super = self.cfg.num_layers // p
-            kp_rs = kpool.reshape((n_super, p) + kpool.shape[1:])
-            vp_rs = vpool.reshape((n_super, p) + vpool.shape[1:])
-            win_rs = None if windows is None else windows.reshape(-1, p)
 
-            def super_layer(h, xs):
-                groups_t, kp_t, vp_t, win_t = xs
-                kp_out, vp_out = [], []
-                for j, (tag, _) in enumerate(model._groups):
-                    w_j = None if win_t is None else win_t[j]
-                    h, (kp_j, vp_j) = layer(
-                        h, (groups_t[f"g{j}"], kp_t[j], vp_t[j], w_j), tag=tag)
-                    kp_out.append(kp_j)
-                    vp_out.append(vp_j)
-                return h, (jnp.stack(kp_out), jnp.stack(vp_out))
+        def body(h, lp, xs_t, tag):
+            kp, vp, win = xs_t
+            return layer(h, (lp, kp, vp, win), tag=tag)
 
-            h, (kp_rs, vp_rs) = jax.lax.scan(
-                super_layer, h, (params["layers"], kp_rs, vp_rs, win_rs))
-            return (h, kp_rs.reshape(kpool.shape), vp_rs.reshape(vpool.shape))
-        # contiguous segments: one scan per run; pool slices re-concatenated
-        kp_parts, vp_parts = [], []
-        for gi, (tag, idxs) in enumerate(model._groups):
-            lo, n = idxs[0], len(idxs)
-            win_seg = None if windows is None else windows[lo:lo + n]
-            h, (kp_g, vp_g) = jax.lax.scan(
-                functools.partial(layer, tag=tag), h,
-                (params["layers"][f"g{gi}"], kpool[lo:lo + n],
-                 vpool[lo:lo + n], win_seg))
-            kp_parts.append(kp_g)
-            vp_parts.append(vp_g)
-        return (h, jnp.concatenate(kp_parts), jnp.concatenate(vp_parts))
+        h, (kpool, vpool) = walk_layer_plan(
+            model._plan, model._groups, params["layers"],
+            (kpool, vpool, windows), h, body)
+        return h, kpool, vpool
 
     def _head(self, params, h, valid_counts):
         """Last-valid-token logits (B, V) from normed hidden states."""

@@ -399,6 +399,110 @@ def apply_moe_grouped(params, x, cfg: TransformerConfig):
     return out.reshape(b, s, e), aux_loss
 
 
+def apply_moe_grouped_ep(params, x, cfg: TransformerConfig, mesh):
+    """Dropless grouped MoE under a SHARDED expert axis (megablox-under-EP;
+    reference analog: ``inference/v2/kernels/cutlass_ops/moe_gemm`` +
+    ``deepspeed/moe/sharded_moe.py:533 _AllToAll``).
+
+    A shard_map manual over the token-carrying axes + ``expert``:
+    each device routes its local tokens, lays rows destined to expert-shard
+    ``s`` into slot block ``s`` of a static (ep, R, E) buffer, all-to-all
+    over the expert axis, runs ONE local ``ragged_dot`` over the received
+    rows sorted by local expert (ragged_dot zero-fills and skips the empty
+    tail, so compute scales with the rows actually routed here), and
+    all-to-alls results back for the weighted combine. R = T_local * k — the
+    worst case, so NO token is ever dropped regardless of routing imbalance
+    (the capacity-einsum path drops at C); memory is over-provisioned
+    instead, the standard static-shape tradeoff on XLA.
+    """
+    from jax.sharding import PartitionSpec as P
+    from ..moe.sharded_moe import topk_gating_grouped
+    from ..ops.pallas.grouped_gemm import moe_expert_ffn
+
+    from ..utils import groups as _groups
+
+    dt = cfg.act_dtype
+    k = cfg.num_experts_per_tok
+    n_exp = cfg.num_experts
+    ep = mesh.shape["expert"]
+    n_local = n_exp // ep
+    # tokens' batch dim is sharded over ALL data-like axes (expert included:
+    # EP groups split the batch, reference groups.py expert_parallel groups)
+    batch_axes = tuple(a for a in _groups.BATCH_AXES
+                       if mesh.shape.get(a, 1) > 1)
+    seq_axis = "seq" if mesh.shape.get("seq", 1) > 1 else None
+    manual = set(batch_axes) | {"expert"} | ({seq_axis} if seq_axis else set())
+
+    def body(router, wi_gate, wi_up, wo, x):
+        b, s, e = x.shape
+        tokens = x.reshape(b * s, e)
+        t_loc = tokens.shape[0]
+        r_buf = t_loc * k
+
+        logits = jnp.einsum("te,ex->tx", tokens.astype(jnp.float32),
+                            router.astype(jnp.float32))
+        topk_idx, w, _ = topk_gating_grouped(logits, k=k,
+                                             normalize=cfg.moe_norm_topk)
+        # GShard aux over the GLOBAL token set, from psum'd sufficient
+        # statistics (per-shard means of products != products of global
+        # means; the einsum path aggregates globally, so must this one)
+        gates = jax.nn.softmax(logits, axis=-1)
+        mask_tx = jnp.sum(jax.nn.one_hot(topk_idx, n_exp, dtype=jnp.float32),
+                          axis=1)
+        stats = jax.lax.pmean(
+            jnp.stack([jnp.mean(gates, axis=0), jnp.mean(mask_tx, axis=0)]),
+            tuple(sorted(manual)))
+        aux = n_exp * jnp.sum(stats[0] * stats[1])
+        er = topk_idx.reshape(-1)                       # (T*k,) global expert
+        ts = er // n_local                              # target expert shard
+        le = er % n_local                               # local id on target
+
+        order = jnp.argsort(ts, stable=True)
+        ts_s = jnp.take(ts, order)
+        counts = jnp.bincount(ts_s, length=ep)
+        starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                                  jnp.cumsum(counts)[:-1]])
+        pos = jnp.arange(r_buf) - jnp.take(starts, ts_s)   # rank within shard
+        slot = ts_s * r_buf + pos                          # (T*k,) send slot
+        tok_of_sorted = order // k
+        send = jnp.zeros((ep * r_buf, e), dt).at[slot].set(
+            jnp.take(tokens, tok_of_sorted, axis=0).astype(dt))
+        send_le = jnp.full((ep * r_buf,), n_local, jnp.int32).at[slot].set(
+            jnp.take(le, order))
+
+        recv = jax.lax.all_to_all(send.reshape(ep, r_buf, e), "expert", 0, 0,
+                                  tiled=False).reshape(ep * r_buf, e)
+        recv_le = jax.lax.all_to_all(send_le.reshape(ep, r_buf), "expert",
+                                     0, 0, tiled=False).reshape(ep * r_buf)
+
+        order2 = jnp.argsort(recv_le, stable=True)
+        rows = jnp.take(recv, order2, axis=0)
+        group_sizes = jnp.bincount(recv_le, length=n_local).astype(jnp.int32)
+        ffn = moe_expert_ffn(rows, wi_gate.astype(dt), wi_up.astype(dt),
+                             wo.astype(dt), group_sizes)
+        back = jnp.zeros_like(ffn).at[order2].set(ffn)
+        back = jax.lax.all_to_all(back.reshape(ep, r_buf, e), "expert", 0, 0,
+                                  tiled=False).reshape(ep * r_buf, e)
+
+        row_out = jnp.take(back, slot, axis=0)          # sorted-row results
+        w_sorted = jnp.take(w.reshape(-1), order).astype(dt)
+        out = jnp.zeros((t_loc, e), dt).at[tok_of_sorted].add(
+            row_out * w_sorted[:, None])
+        return out.reshape(b, s, e), aux
+
+    tok_spec = P(batch_axes or None, seq_axis, None)
+    fn = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(), P("expert"), P("expert"), P("expert"), tok_spec),
+        out_specs=(tok_spec, P()),
+        axis_names=manual)
+    out, aux = fn(params["router"], params["wi_gate"], params["wi_up"],
+                  params["wo"], x)
+    if cfg.moe_shared_expert_size:
+        out = out + _apply_shared_expert(params, x.astype(dt), cfg)
+    return out, aux
+
+
 def apply_moe_mlp(params, x, cfg: TransformerConfig):
     """Dispatch/combine via one-hot einsum (GShard-style, reference
     ``deepspeed/moe/sharded_moe.py:96 MOELayer``). Capacity-bounded, dropless
@@ -413,11 +517,27 @@ def apply_moe_mlp(params, x, cfg: TransformerConfig):
 
     if cfg.moe_impl == "grouped":
         from ..utils import groups as _g
+        from ..parallel.sharding import current_manual_axes as _cma
         ep = (_g.get_mesh().shape.get("expert", 1)
               if _g.mesh_is_initialized() else 1)
         if ep == 1:
             return apply_moe_grouped(params, x, cfg)
-        # EP needs the einsum dispatch (it IS the all-to-all); fall through
+        if not _cma():
+            # sharded expert axis: dropless grouped path with an explicit
+            # all-to-all ring (cannot nest inside an existing manual region
+            # — the ZeRO++ step falls through to the einsum dispatch).
+            # Guard the manual region's static divisibility contracts: the
+            # einsum dispatch tolerates anything via GSPMD padding, so odd
+            # shapes (v1 serving with b=1, ragged expert counts) fall back
+            # loudly-documented rather than mis-routing.
+            import math as _math
+            mesh = _g.get_mesh()
+            bsz, slen, _ = x.shape
+            bdiv = _math.prod(mesh.shape.get(a, 1) for a in _g.BATCH_AXES)
+            sdiv = mesh.shape.get("seq", 1)
+            if (cfg.num_experts % ep == 0 and bsz % bdiv == 0
+                    and slen % sdiv == 0):
+                return apply_moe_grouped_ep(params, x, cfg, mesh)
 
     # Explicit dispatch/combine layouts (the reference's all-to-all
     # semantics, sharded_moe.py:533 _AllToAll): tokens ride the batch axes,

@@ -129,6 +129,65 @@ def layer_groups(cfg):
             for tag, start, ln in plan[1]]
 
 
+def walk_layer_plan(plan, groups_, layers_params, xs, carry, body, wrap=None):
+    """Single driver for the layer-plan walk — train forward, cached decode,
+    and the paged serving runner all follow the same three shapes, so the
+    group ordering/slicing logic lives exactly once.
+
+    ``plan``/``groups_``: the model's ``layer_plan``/``layer_groups``
+    (None = homogeneous). ``layers_params``: the (possibly grouped) stacked
+    layer tree. ``xs``: pytree of per-layer inputs with leading axis L in
+    ORIGINAL layer order (None leaves pass through). ``body(carry, lp, xs_t,
+    tag) -> (carry, ys_t)`` applies one layer (ys_t may be None).
+    ``wrap``: optional transform applied to each scan-step function (remat);
+    for the periodic plan it wraps the whole super-layer step, matching the
+    one-checkpoint-per-scan-step policy of the homogeneous path.
+
+    Returns (carry, ys) with ys leaves stacked back in original layer order.
+    """
+    wrap = wrap or (lambda f: f)
+    if groups_ is None:
+        def step(carry, t):
+            lp, xs_t = t
+            return body(carry, lp, xs_t, None)
+        return jax.lax.scan(wrap(step), carry, (layers_params, xs))
+    if plan[0] == "periodic":
+        p = plan[1]
+        xs_rs = jax.tree.map(
+            lambda a: a.reshape((a.shape[0] // p, p) + a.shape[1:]), xs)
+
+        def super_step(carry, t):
+            groups_t, xs_t = t
+            ys = []
+            for j, (tag, _) in enumerate(groups_):
+                xj = jax.tree.map(lambda a: a[j], xs_t)
+                carry, y = body(carry, groups_t[f"g{j}"], xj, tag)
+                ys.append(y)
+            stacked = (None if ys[0] is None
+                       else jax.tree.map(lambda *z: jnp.stack(z), *ys))
+            return carry, stacked
+
+        carry, ys = jax.lax.scan(wrap(super_step), carry, (layers_params, xs_rs))
+        ys = jax.tree.map(lambda a: a.reshape((-1,) + a.shape[2:]), ys)
+        return carry, ys
+    # contiguous segments: one scan per run, ys re-concatenated in order
+    parts = []
+    for gi, (tag, idxs) in enumerate(groups_):
+        lo, n = idxs[0], len(idxs)
+        xs_seg = jax.tree.map(lambda a: a[lo:lo + n], xs)
+
+        def step(carry, t, _tag=tag):
+            lp, xs_t = t
+            return body(carry, lp, xs_t, _tag)
+
+        carry, y = jax.lax.scan(wrap(step), carry,
+                                (layers_params[f"g{gi}"], xs_seg))
+        parts.append(y)
+    ys = (None if parts[0] is None
+          else jax.tree.map(lambda *z: jnp.concatenate(z), *parts))
+    return carry, ys
+
+
 def lm_head_logits(h, w, transpose, dt, bias=None, softcap=0.0):
     """logits = h @ (w if transpose else w.T) (+ bias): (B, S, E) → (B, S, V).
 
@@ -358,44 +417,14 @@ class CausalLM:
             return (jax.checkpoint(fn, policy=_remat_policy(cfg.remat))
                     if cfg.remat != "none" else fn)
 
-        def run_scan(stacked, win_slice, tag, carry):
-            def body(carry, xs):
-                lp, win = xs
-                h, aux_sum = carry
-                h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias,
-                                        win, layer_type=tag)
-                return (constrain(h), aux_sum + aux), None
+        def body(carry, lp, win, tag):
+            h, aux_sum = carry
+            h, aux = self._layer_fn(lp, h, positions, segment_ids, attn_bias,
+                                    win, layer_type=tag)
+            return (constrain(h), aux_sum + aux), None
 
-            carry, _ = jax.lax.scan(make_body(body), carry, (stacked, win_slice))
-            return carry
-
-        if self._groups is None:
-            carry = run_scan(params["layers"], windows, None, carry)
-        elif self._plan[0] == "periodic":
-            # one scan over L/p super-layers; the body applies the p
-            # per-position sublayers in order (layer t*p+j is group j step t)
-            p = self._plan[1]
-            win_rs = None if windows is None else windows.reshape(-1, p)
-
-            def body(carry, xs):
-                groups_t, win_t = xs
-                h, aux_sum = carry
-                for j, (tag, _) in enumerate(self._groups):
-                    w_j = None if win_t is None else win_t[j]
-                    h, aux = self._layer_fn(groups_t[f"g{j}"], h, positions,
-                                            segment_ids, attn_bias, w_j,
-                                            layer_type=tag)
-                    aux_sum = aux_sum + aux
-                return (constrain(h), aux_sum), None
-
-            carry, _ = jax.lax.scan(make_body(body), carry,
-                                    (params["layers"], win_rs))
-        else:   # contiguous segments: one scan per run
-            for gi, (tag, idxs) in enumerate(self._groups):
-                w_seg = None if windows is None else \
-                    windows[idxs[0]:idxs[0] + len(idxs)]
-                carry = run_scan(params["layers"][f"g{gi}"], w_seg, tag, carry)
-
+        carry, _ = walk_layer_plan(self._plan, self._groups, params["layers"],
+                                   windows, carry, body, wrap=make_body)
         h, aux_total = carry
         if not cfg.post_norm:
             h = L.apply_norm(params["final_norm"], h, cfg)
@@ -477,51 +506,13 @@ class CausalLM:
                 return h + attn_out + mlp_out, kv
             return h + mlp_out, kv
 
-        if self._groups is None:
-            def body(h, layer_in):
-                lp, ck, cv, win = layer_in
-                return dec_layer(lp, h, ck, cv, win)
+        def body(h, lp, xs_t, tag):
+            ck, cv, win = xs_t
+            return dec_layer(lp, h, ck, cv, win, tag)
 
-            h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], cache["k"],
-                                                       cache["v"], windows))
-        elif self._plan[0] == "periodic":
-            p = self._plan[1]
-            ck_rs = cache["k"].reshape((-1, p) + cache["k"].shape[1:])
-            cv_rs = cache["v"].reshape((-1, p) + cache["v"].shape[1:])
-            win_rs = None if windows is None else windows.reshape(-1, p)
-
-            def body(h, layer_in):
-                groups_t, ck_t, cv_t, win_t = layer_in
-                ks, vs = [], []
-                for j, (tag, _) in enumerate(self._groups):
-                    w_j = None if win_t is None else win_t[j]
-                    h, (k_j, v_j) = dec_layer(groups_t[f"g{j}"], h, ck_t[j],
-                                              cv_t[j], w_j, tag)
-                    ks.append(k_j)
-                    vs.append(v_j)
-                return h, (jnp.stack(ks), jnp.stack(vs))
-
-            h, (new_k, new_v) = jax.lax.scan(body, h, (params["layers"], ck_rs,
-                                                       cv_rs, win_rs))
-            new_k = new_k.reshape(cache["k"].shape)
-            new_v = new_v.reshape(cache["v"].shape)
-        else:   # contiguous segments
-            ks, vs = [], []
-            for gi, (tag, idxs) in enumerate(self._groups):
-                lo, n = idxs[0], len(idxs)
-                w_seg = None if windows is None else windows[lo:lo + n]
-
-                def body(h, layer_in, _tag=tag):
-                    lp, ck, cv, win = layer_in
-                    return dec_layer(lp, h, ck, cv, win, _tag)
-
-                h, (k_g, v_g) = jax.lax.scan(
-                    body, h, (params["layers"][f"g{gi}"], cache["k"][lo:lo + n],
-                              cache["v"][lo:lo + n], w_seg))
-                ks.append(k_g)
-                vs.append(v_g)
-            new_k = jnp.concatenate(ks)
-            new_v = jnp.concatenate(vs)
+        h, (new_k, new_v) = walk_layer_plan(
+            self._plan, self._groups, params["layers"],
+            (cache["k"], cache["v"], windows), h, body)
         h = L.apply_norm(params["final_norm"], h, cfg)
         w, transpose = self._lm_head_weight(params)
         logits = lm_head_logits(h, w, transpose, dt,

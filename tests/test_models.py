@@ -142,19 +142,48 @@ def test_moe_grouped_dropless_beyond_capacity():
     assert abs(le - lg) > 1e-6  # einsum dropped tokens, grouped did not
 
 
-def test_moe_grouped_falls_back_under_ep():
-    """With a sharded expert axis the grouped flag falls back to the einsum
-    all-to-all dispatch (grouped rows cannot be statically expert-sharded)."""
+def test_moe_grouped_ep_matches_einsum():
+    """Dropless grouped MoE under a SHARDED expert axis (explicit all-to-all
+    ring + local ragged_dot, ``apply_moe_grouped_ep``) reproduces the
+    capacity-einsum dispatch on a data x expert mesh when capacity is
+    generous enough that nothing drops — same loss, same grads."""
     from deepspeed_tpu.utils import groups
     groups.reset_mesh()
     groups.set_mesh(groups.build_mesh(expert=2, data=4))
-    cfg = get_config("tiny-moe").replace(moe_impl="grouped")
-    model = build_model(cfg)
-    params = jax.jit(model.init)(jax.random.PRNGKey(0))
+    cfg = get_config("tiny-moe").replace(moe_capacity_factor=8.0)
+    me = build_model(cfg)
+    mg = build_model(cfg.replace(moe_impl="grouped"))
+    params = jax.jit(me.init)(jax.random.PRNGKey(0))
     r = np.random.default_rng(0)
     ids = jnp.asarray(r.integers(0, 256, (8, 32)))
-    loss = float(model.loss(params, {"input_ids": ids, "labels": ids}))
-    assert np.isfinite(loss)
+    batch = {"input_ids": ids, "labels": ids}
+    le, ge = jax.jit(jax.value_and_grad(me.loss))(params, batch)
+    lg, gg = jax.jit(jax.value_and_grad(mg.loss))(params, batch)
+    np.testing.assert_allclose(float(le), float(lg), rtol=2e-5)
+    for a, b in zip(jax.tree.leaves(ge), jax.tree.leaves(gg)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-3, atol=5e-4)
+
+
+def test_moe_grouped_ep_dropless_beyond_capacity():
+    """Under EP with a tight capacity factor the einsum path drops tokens;
+    the grouped-EP ring keeps every token (static worst-case slot buffers)
+    and trains a finite, different loss."""
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(expert=2, data=4))
+    cfg = get_config("tiny-moe").replace(moe_capacity_factor=0.25)
+    me = build_model(cfg)
+    mg = build_model(cfg.replace(moe_impl="grouped"))
+    params = jax.jit(me.init)(jax.random.PRNGKey(1))
+    r = np.random.default_rng(1)
+    ids = jnp.asarray(r.integers(0, 256, (8, 32)))
+    batch = {"input_ids": ids, "labels": ids}
+    le = float(me.loss(params, batch))
+    lg = float(mg.loss(params, batch))
+    assert np.isfinite(lg)
+    assert abs(le - lg) > 1e-6  # einsum dropped tokens, grouped-EP did not
 
 
 def test_alibi_slopes_standard_values():
