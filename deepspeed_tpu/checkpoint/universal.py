@@ -44,15 +44,37 @@ def _unflatten_from_paths(flat: Dict[str, Any]):
     return root
 
 
+def _fetch_replicated(engine, tree):
+    """Consolidate a (possibly ZeRO-sharded, possibly multi-process) state
+    tree to host numpy, leaf by leaf: each leaf is replicated through a
+    compiled identity before the fetch (device_get of a non-fully-addressable
+    array is invalid in multi-process runs), and doing it per leaf bounds the
+    transient device allocation to the largest single tensor instead of the
+    whole fp32 optimizer state at once."""
+    def fetch(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            with engine.mesh:
+                x = jax.jit(lambda t: t,
+                            out_shardings=engine._replicated)(x)
+        return np.asarray(jax.device_get(x))
+    return jax.tree.map(fetch, tree)
+
+
 def ds_to_universal(engine, output_dir: str):
     """Write the engine's full state as atomic per-parameter .npy files
-    (reference ds_to_universal main:469)."""
+    (reference ds_to_universal main:469). Multi-process: every rank joins
+    the consolidation allgather; rank 0 writes the files."""
     os.makedirs(output_dir, exist_ok=True)
     engine._swap_in_opt_state()
+    opt_tree = (engine._host_optimizer.state_dict()
+                if getattr(engine, "_host_optimizer", None) is not None
+                else engine.opt_state)
     state = {
-        "module": jax.device_get(engine.module_state_dict()),
-        "optimizer": jax.device_get(engine.opt_state),
+        "module": engine.module_state_dict(),
+        "optimizer": _fetch_replicated(engine, opt_tree),
     }
+    if jax.process_index() != 0:
+        return None
     index = {"params": [], "meta": {
         "global_steps": engine.global_steps,
         "global_samples": engine.global_samples,

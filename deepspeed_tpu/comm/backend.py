@@ -91,10 +91,15 @@ class XlaBackend:
         if num_processes is not None and num_processes > 1:
             # Must run before ANY jax call that touches the XLA backend
             # (callers must not query jax.devices()/process_count() first).
+            kw = {}
+            hb = os.environ.get("DS_ELASTIC_HEARTBEAT_S")
+            if hb:   # elastic bring-up: fast failure detection
+                kw["heartbeat_timeout_seconds"] = int(hb)
             jax.distributed.initialize(
                 coordinator_address=coordinator_address,
                 num_processes=num_processes,
                 process_id=process_id,
+                **kw,
             )
         self._initialized = True
 

@@ -126,14 +126,22 @@ def init_distributed(dist_backend="xla",
                      config=None,
                      rank=-1,
                      world_size=-1,
-                     mesh_config=None):
+                     mesh_config=None,
+                     elastic=False):
     """Bring up the (multi-host) runtime and the global device mesh.
 
     Analog of ``deepspeed/comm/comm.py:619``. Single-host: no-op rendezvous.
     Multi-host: ``jax.distributed.initialize`` (TPU pods auto-discover via the
     metadata server, so coordinator args are optional there).
+
+    ``elastic=True`` brings the runtime up recoverable (survivors are NOT
+    aborted when a peer dies) with a short failure-detection heartbeat —
+    required for in-process rejoin (``elasticity/rejoin.py``).
     """
     global cdb, comms_logger
+    if elastic:
+        from ..elasticity.rejoin import InProcessElasticWorker
+        InProcessElasticWorker.configure_jax()
     if cdb is not None and cdb.initialized:
         # comm backend persists across engines in one process; the mesh may
         # still need (re)building from this config (e.g. a MiCS/hpZ zrep split)

@@ -1,4 +1,4 @@
-"""Elastic training agent.
+"""Elastic training agent (restart-based supervision).
 
 Analog of ``deepspeed/elasticity/elastic_agent.py:32`` (DSElasticAgent, an
 extension of torch-elastic's LocalElasticAgent): supervise the worker
@@ -7,6 +7,11 @@ world size the precomputed elastic batch configuration admits, resuming
 from the latest checkpoint. Torch-elastic's rendezvous is replaced by the
 launcher's hostfile contract: ``jax.distributed.initialize`` performs the
 actual process-group bring-up on restart.
+
+For recovery WITHOUT a process restart — surviving workers tear down the
+distributed runtime in place, rebuild the mesh at the remaining world size,
+and reshard from a universal checkpoint — see ``elasticity/rejoin.py``
+(InProcessElasticWorker).
 """
 
 import subprocess

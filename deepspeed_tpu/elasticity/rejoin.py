@@ -1,0 +1,151 @@
+"""In-process elastic rejoin: survive membership changes WITHOUT restart.
+
+Analog of the reference's elastic agent semantics
+(``deepspeed/elasticity/elastic_agent.py:32``) taken one step further: the
+reference (like torch-elastic) tears the worker processes down and respawns
+them at the new world size; here the SURVIVING process itself rebuilds —
+tear down the JAX distributed runtime, re-initialize at the remaining world
+size, rebuild the mesh, reshard from the latest universal checkpoint
+(``checkpoint/universal.py``), and keep training in the same PID.
+
+Requirements baked into the flow:
+- the initial bring-up must run with JAX recoverability on
+  (``jax.config.jax_enable_recoverability`` — without it the coordination
+  service hard-aborts every surviving process the moment a peer dies) and a
+  short heartbeat timeout; ``comm.init_distributed(elastic=True)`` or
+  ``InProcessElasticWorker.configure_jax()`` sets both;
+- a universal checkpoint must exist from BEFORE the failure: a dead peer
+  takes its ZeRO shards with it, so recovery rolls back to the last
+  universal snapshot (standard elastic semantics — the reference's agent
+  also resumes "from the latest checkpoint").
+
+The liveness signal is deliberately simple and transport-free: per-rank
+heartbeat files under a shared run directory (the launcher's shared-FS
+contract). Anything smarter (coordination-service queries) couples recovery
+to the very service that just lost a member.
+"""
+
+import os
+import time
+from typing import Callable, List, Optional
+
+from ..utils.logging import logger
+
+
+class InProcessElasticWorker:
+    """Membership tracking + in-process rebuild for one training process.
+
+    ``make_engine(world_size) -> engine`` must build the full stack for the
+    given world size from scratch (mesh from the then-visible devices, batch
+    config from the elastic schedule) — it runs once at start and once per
+    rejoin, AFTER the runtime has been torn down and re-initialized.
+    """
+
+    def __init__(self, make_engine: Callable[[int], object], ckpt_dir: str,
+                 run_dir: str, heartbeat_timeout: float = 10.0):
+        self.make_engine = make_engine
+        self.ckpt_dir = ckpt_dir
+        self.run_dir = run_dir
+        self.heartbeat_timeout = float(heartbeat_timeout)
+        self.rank: Optional[int] = None
+        self.world: Optional[int] = None
+        os.makedirs(run_dir, exist_ok=True)
+
+    # ---- liveness ----------------------------------------------------
+
+    def _hb_path(self, rank: int) -> str:
+        return os.path.join(self.run_dir, f"heartbeat.{rank}")
+
+    @staticmethod
+    def configure_jax(heartbeat_timeout_seconds: int = 5):
+        """Must run BEFORE jax.distributed.initialize: recoverability keeps
+        the coordination service from aborting survivors on peer death."""
+        import jax
+        jax.config.update("jax_enable_recoverability", True)
+        os.environ.setdefault("DS_ELASTIC_HEARTBEAT_S",
+                              str(heartbeat_timeout_seconds))
+
+    def start(self, rank: int, world: int):
+        self.rank = int(rank)
+        self.world = int(world)
+        self.heartbeat()
+
+    def heartbeat(self):
+        path = self._hb_path(self.rank)
+        with open(path, "w") as f:
+            f.write(str(time.time()))
+
+    def alive_ranks(self) -> List[int]:
+        now = time.time()
+        alive = []
+        for r in range(self.world):
+            try:
+                if now - os.path.getmtime(self._hb_path(r)) <= self.heartbeat_timeout:
+                    alive.append(r)
+            except OSError:
+                pass
+        return alive
+
+    def membership_changed(self) -> bool:
+        return len(self.alive_ranks()) < self.world
+
+    # ---- checkpoint --------------------------------------------------
+
+    def save_universal(self, engine):
+        """Periodic world-size-agnostic snapshot — the recovery point."""
+        from ..checkpoint.universal import ds_to_universal
+        ds_to_universal(engine, self.ckpt_dir)
+
+    # ---- the rejoin itself -------------------------------------------
+
+    def rejoin(self):
+        """Tear down the distributed runtime, come back at the surviving
+        world size, reshard from the universal checkpoint. Returns the new
+        engine; the old one (and every array it held) is invalid after this.
+        """
+        import jax
+
+        # refresh own liveness first: a survivor whose heartbeat went stale
+        # (blocked in a long step) must not drop out of its own alive set —
+        # that would collapse new_rank to 0 on several survivors at once
+        self.heartbeat()
+        alive = self.alive_ranks()
+        new_world = max(1, len(alive))
+        logger.warning(
+            f"[elastic-rejoin] membership change: {self.world} -> {new_world} "
+            f"processes (alive ranks {alive}); rebuilding in-process")
+
+        from ..comm import comm as dist
+        from ..utils import groups
+        try:
+            dist.destroy_process_group()
+        except Exception as e:  # a failed shutdown barrier is EXPECTED here
+            logger.warning(f"[elastic-rejoin] destroy_process_group: {e}")
+        try:
+            jax.distributed.shutdown()
+        except Exception as e:
+            logger.warning(f"[elastic-rejoin] jax.distributed.shutdown: {e}")
+        jax.clear_caches()
+        from jax.extend import backend as jax_backend
+        jax_backend.clear_backends()
+        groups.reset_mesh()
+
+        # new rank = position among survivors; re-rendezvous only if >1 left
+        new_rank = alive.index(self.rank) if self.rank in alive else 0
+        os.environ["RANK"] = str(new_rank)
+        os.environ["WORLD_SIZE"] = str(new_world)
+        if new_world > 1:
+            jax.distributed.initialize(
+                num_processes=new_world, process_id=new_rank,
+                heartbeat_timeout_seconds=int(
+                    os.environ.get("DS_ELASTIC_HEARTBEAT_S", "5")))
+
+        self.rank, self.world = new_rank, new_world
+        engine = self.make_engine(new_world)
+        from ..checkpoint.universal import load_universal_checkpoint
+        meta = load_universal_checkpoint(engine, self.ckpt_dir)
+        self.heartbeat()
+        logger.warning(
+            f"[elastic-rejoin] resumed at world={new_world} from "
+            f"global_step={meta.get('global_steps', 0)}")
+        return engine

@@ -221,21 +221,9 @@ class InferenceEngineV2:
             for s in seqs:
                 if not self.state.ensure_capacity(s, s.seen_tokens + remaining + 1):
                     raise RuntimeError("KV pool exhausted for compiled decode loop")
-            b = len(seqs)
             last_ids = np.asarray([s.generated[-1] for s in seqs], np.int32)
             lens = np.asarray([s.seen_tokens for s in seqs], np.int32)
-            # Size the block table to the pages THIS call can touch (padded
-            # to a power of two to bound recompiles): attention cost per
-            # decode token scales with table width, so a 1k-ctx model
-            # serving 192-token requests pays for 4 pages, not 16.
-            need = max(len(s.blocks) for s in seqs)
-            mb = 1
-            while mb < min(need, self.max_blocks_per_seq):
-                mb *= 2
-            mb = min(mb, self.max_blocks_per_seq)
-            tables = np.zeros((b, mb), np.int32)
-            for i, s in enumerate(seqs):
-                tables[i, :len(s.blocks)] = s.blocks
+            tables = self._block_tables(seqs)
             self._rng, sub = jax.random.split(self._rng)
             toks, self.kv.k, self.kv.v = self.runner.decode_loop(
                 self.params, jnp.asarray(last_ids), jnp.asarray(lens),
@@ -252,6 +240,70 @@ class InferenceEngineV2:
             g = self.state.seqs[u].generated[:max_new_tokens]
             if eos_token_id is not None and eos_token_id in g:
                 g = g[: g.index(eos_token_id) + 1]
+            outs.append(np.asarray(g))
+        self.flush(uids)
+        return outs
+
+    def _block_tables(self, seqs) -> np.ndarray:
+        """Block tables sized to the pages THIS call can touch (padded to a
+        power of two to bound recompiles): attention cost per decode token
+        scales with table width, so a 1k-ctx model serving 192-token
+        requests pays for 4 pages, not 16."""
+        need = max(len(s.blocks) for s in seqs)
+        mb = 1
+        while mb < min(need, self.max_blocks_per_seq):
+            mb *= 2
+        mb = min(mb, self.max_blocks_per_seq)
+        tables = np.zeros((len(seqs), mb), np.int32)
+        for i, s in enumerate(seqs):
+            tables[i, :len(s.blocks)] = s.blocks
+        return tables
+
+    def generate_compiled(self, prompts: List[np.ndarray],
+                          max_new_tokens: int = 32, temperature: float = 0.0,
+                          eos_token_id: Optional[int] = None):
+        """Fully-compiled SplitFuse generation: chunked prefill, staggered
+        prefill->decode transitions, and decode run as ONE jit (two scans
+        sharing per-row state) — no host round-trips between steps. Same
+        outputs as ``generate`` for static workloads; ``step()`` remains the
+        path for continuous batching with dynamic arrivals."""
+        c = self._config
+        uids = list(range(len(prompts)))
+        self.put(uids, prompts)
+        seqs = [self.state.seqs[u] for u in uids]
+        for s in seqs:
+            if not self.state.ensure_capacity(
+                    s, len(s.pending) + max_new_tokens + 1):
+                raise RuntimeError("KV pool exhausted for compiled mixed loop")
+        b = len(seqs)
+        plens = np.asarray([len(s.pending) for s in seqs], np.int32)
+        pmax = int(plens.max())
+        prompts_p = np.zeros((b, pmax), np.int32)
+        for i, s in enumerate(seqs):
+            prompts_p[i, :plens[i]] = s.pending
+        tables = self._block_tables(seqs)
+        chunk = c.prefill_chunk_size
+        wide_steps = -(-pmax // chunk)
+        self._rng, sub = jax.random.split(self._rng)
+        toks, emit, self.kv.k, self.kv.v = self.runner.mixed_loop(
+            self.params, jnp.asarray(prompts_p), jnp.asarray(plens),
+            jnp.full((b,), max_new_tokens, jnp.int32), self.kv.k, self.kv.v,
+            jnp.asarray(tables), sub, jnp.float32(temperature),
+            chunk=chunk, wide_steps=wide_steps,
+            narrow_steps=max(0, max_new_tokens - 1),
+            greedy=temperature == 0.0)
+        toks = np.asarray(toks)
+        emit = np.asarray(emit)
+        outs = []
+        for i, s in enumerate(seqs):
+            g = [int(t) for t, e in zip(toks[:, i], emit[:, i]) if e]
+            g = g[:max_new_tokens]
+            if eos_token_id is not None and eos_token_id in g:
+                g = g[: g.index(eos_token_id) + 1]
+            s.pending = []
+            s.generated.extend(g)
+            s.seen_tokens = int(plens[i]) + max_new_tokens
+            s.done = True
             outs.append(np.asarray(g))
         self.flush(uids)
         return outs

@@ -356,8 +356,10 @@ OPTIMIZER_REGISTRY = {
     "lion": FusedLion,
     "fusedlion": FusedLion,
     "deepspeedcpulion": DeepSpeedCPULion,
+    "cpulion": DeepSpeedCPULion,
     "adagrad": FusedAdagrad,
     "deepspeedcpuadagrad": DeepSpeedCPUAdagrad,
+    "cpuadagrad": DeepSpeedCPUAdagrad,
     "sgd": SGD,
     "onebitadam": OneBitAdam,
     "onebitlamb": OneBitLamb,

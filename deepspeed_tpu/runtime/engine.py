@@ -214,7 +214,8 @@ class DeepSpeedEngine:
                     [p if m else None for p, m in zip(flat, mask)])
                 self._host_optimizer = HostOffloadOptimizer(
                     self.optimizer.hyper, host_masked, self._opt_param_shardings,
-                    gradient_clipping=float(self._config.gradient_clipping or 0.0))
+                    gradient_clipping=float(self._config.gradient_clipping or 0.0),
+                    optimizer_name=self.optimizer.name)
                 dev_flat = jax.tree.leaves(self.module_params)
                 dev_masked = treedef.unflatten(
                     [p if not m else None for p, m in zip(dev_flat, mask)])
@@ -231,7 +232,8 @@ class DeepSpeedEngine:
             else:
                 self._host_optimizer = HostOffloadOptimizer(
                     self.optimizer.hyper, host_tree, self._opt_param_shardings,
-                    gradient_clipping=float(self._config.gradient_clipping or 0.0))
+                    gradient_clipping=float(self._config.gradient_clipping or 0.0),
+                    optimizer_name=self.optimizer.name)
                 log_dist("ZeRO-Offload: native host CPUAdam in the step loop "
                          f"({self._host_optimizer.local_element_count():,} "
                          "master elements on this process)", ranks=[0])
@@ -364,9 +366,13 @@ class DeepSpeedEngine:
             return build_optimizer("adamw", {"lr": 1e-3})
         name = opt_cfg.type
         params = dict(opt_cfg.params)
-        # honor offload: cpu_adam is the same math, placement handled by engine
-        if self.offload_optimizer and name.lower() in ("adam", "adamw", "fusedadam"):
-            name = "cpuadam"
+        # honor offload: cpu_* is the same math, placement handled by the
+        # engine (reference csrc/{adam,adagrad,lion} host-kernel set)
+        if self.offload_optimizer:
+            key = name.lower().replace("_", "").replace("-", "")
+            name = {"adam": "cpuadam", "adamw": "cpuadam",
+                    "fusedadam": "cpuadam", "adagrad": "cpuadagrad",
+                    "lion": "cpulion"}.get(key, name)
         return build_optimizer(name, params)
 
     def _configure_lr_scheduler(self, client_scheduler) -> Optional[LRSchedule]:

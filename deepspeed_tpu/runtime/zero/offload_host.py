@@ -35,6 +35,99 @@ import numpy as np
 from .infinity import _HostAdam
 
 
+class _KernelAdam:
+    """{m, v} slots; native ``ds_cpu_adam_step`` (csrc/adam/cpu_adam.cpp)."""
+    fields = ("m", "v")
+
+    def __init__(self, hyper):
+        self._adam = _HostAdam(hyper)
+
+    def step(self, master, g, slots, step_num, lr):
+        self._adam.step(master, g, slots["m"], slots["v"], step_num, lr)
+
+
+class _KernelAdagrad:
+    """{acc} slot; native ``ds_cpu_adagrad_step`` (reference
+    ``csrc/adagrad/cpu_adagrad.cpp``)."""
+    fields = ("acc",)
+
+    def __init__(self, hyper):
+        self.lr = float(hyper.get("lr", 1e-2))
+        self.eps = float(hyper.get("eps", 1e-10))
+        self.weight_decay = float(hyper.get("weight_decay", 0.0))
+        self._native = None
+
+    def _fn(self):
+        if self._native is None:
+            try:
+                from ...ops.cpu_adam_native import cpu_adagrad_step
+                self._native = cpu_adagrad_step
+            except Exception:
+                def np_adagrad(p, g, acc, lr, eps, weight_decay):
+                    if weight_decay:
+                        g = g + weight_decay * p
+                    acc += np.square(g)
+                    p -= lr * g / (np.sqrt(acc) + eps)
+                self._native = np_adagrad
+        return self._native
+
+    def step(self, master, g, slots, step_num, lr):
+        self._fn()(master.reshape(-1), g.reshape(-1),
+                   slots["acc"].reshape(-1), lr if lr is not None else self.lr,
+                   self.eps, self.weight_decay)
+
+
+class _KernelLion:
+    """{m} slot; native ``ds_cpu_lion_step`` (reference ``csrc/lion/
+    cpu_lion.cpp``)."""
+    fields = ("m",)
+
+    def __init__(self, hyper):
+        self.lr = float(hyper.get("lr", 1e-4))
+        self.betas = tuple(hyper.get("betas", (0.9, 0.99)))
+        self.weight_decay = float(hyper.get("weight_decay", 0.0))
+        self._native = None
+
+    def _fn(self):
+        if self._native is None:
+            try:
+                from ...ops.cpu_adam_native import cpu_lion_step
+                self._native = cpu_lion_step
+            except Exception:
+                def np_lion(p, g, m, lr, betas, weight_decay):
+                    b1, b2 = betas
+                    update = np.sign(b1 * m + (1 - b1) * g)
+                    if weight_decay:
+                        update = update + weight_decay * p
+                    p -= lr * update
+                    m *= b2
+                    m += (1 - b2) * g
+                self._native = np_lion
+        return self._native
+
+    def step(self, master, g, slots, step_num, lr):
+        self._fn()(master.reshape(-1), g.reshape(-1), slots["m"].reshape(-1),
+                   lr if lr is not None else self.lr, self.betas,
+                   self.weight_decay)
+
+
+_HOST_KERNELS = {
+    "adam": _KernelAdam, "adamw": _KernelAdam, "cpu_adam": _KernelAdam,
+    "adagrad": _KernelAdagrad, "cpu_adagrad": _KernelAdagrad,
+    "lion": _KernelLion, "cpu_lion": _KernelLion,
+}
+
+
+def build_host_kernel(name: str, hyper):
+    key = name.lower().replace("-", "_")
+    if key not in _HOST_KERNELS:
+        raise NotImplementedError(
+            f"native host offload has no CPU kernel for optimizer {name!r}; "
+            f"supported: {sorted(set(_HOST_KERNELS))} (reference ships "
+            "csrc/{adam,adagrad,lion} host kernels)")
+    return _HOST_KERNELS[key](hyper)
+
+
 def _norm_index(index, shape):
     """Normalize a shard index (tuple of slices) to a hashable key."""
     out = []
@@ -53,11 +146,13 @@ class HostOffloadOptimizer:
     """fp32 master + moments on host (local shards), native CPUAdam update."""
 
     def __init__(self, hyper: Dict[str, Any], param_tree, shardings, *,
-                 gradient_clipping: float = 0.0):
+                 gradient_clipping: float = 0.0, optimizer_name: str = "adam"):
         """``param_tree``: module params as (global) jax Arrays ALREADY in the
         optimizer layout; ``shardings``: the matching NamedSharding tree.
-        Leaves may be None (Twin-Flow keeps those on device)."""
-        self.adam = _HostAdam(hyper)
+        Leaves may be None (Twin-Flow keeps those on device).
+        ``optimizer_name`` selects the native host kernel (adam/adagrad/lion
+        — the reference's csrc/{adam,adagrad,lion} set)."""
+        self.kernel = build_host_kernel(optimizer_name, hyper)
         self.hyper = dict(hyper)
         self.gradient_clipping = float(gradient_clipping or 0.0)
 
@@ -77,8 +172,8 @@ class HostOffloadOptimizer:
                 if key not in slices:
                     master = np.array(shard.data, np.float32)
                     slices[key] = {"master": master,
-                                   "m": np.zeros_like(master),
-                                   "v": np.zeros_like(master)}
+                                   **{f: np.zeros_like(master)
+                                      for f in self.kernel.fields}}
             self._leaves.append({
                 "shape": tuple(p.shape),
                 "dtype": np.dtype(p.dtype),
@@ -161,7 +256,7 @@ class HostOffloadOptimizer:
                 elif not gh.flags.writeable or not gh.flags.c_contiguous:
                     gh = np.array(gh)        # jax host views are read-only
                 s = lf["slices"][key]
-                self.adam.step(s["master"], gh, s["m"], s["v"], self._step, lr)
+                self.kernel.step(s["master"], gh, s, self._step, lr)
         return self.params()
 
     def reset_masters(self, param_tree):
@@ -211,13 +306,13 @@ class HostOffloadOptimizer:
         if jax.process_count() == 1:
             slots = self._treedef.unflatten([
                 None if lf is None else {
-                    f: self._assemble_host(lf, f) for f in ("master", "m", "v")}
+                    f: self._assemble_host(lf, f) for f in ("master",) + self.kernel.fields}
                 for lf in self._leaves])
         else:
             slots = self._treedef.unflatten([
                 None if lf is None else {
                     f: self._assemble(lf, f, np.float32)
-                    for f in ("master", "m", "v")}
+                    for f in ("master",) + self.kernel.fields}
                 for lf in self._leaves])
         return {"step": np.asarray(self._step, np.int32), "slots": slots}
 
@@ -229,7 +324,7 @@ class HostOffloadOptimizer:
             None if lf is None else {
                 f: jax.ShapeDtypeStruct(lf["shape"], np.float32,
                                         sharding=lf["sharding"])
-                for f in ("master", "m", "v")}
+                for f in ("master",) + self.kernel.fields}
             for lf in self._leaves])
         return {"step": np.asarray(self._step, np.int32), "slots": slots}
 
@@ -239,7 +334,7 @@ class HostOffloadOptimizer:
         for slot, lf in zip(flat_slots, self._leaves):
             if lf is None or slot is None:
                 continue
-            for f in ("master", "m", "v"):
+            for f in ("master",) + self.kernel.fields:
                 arr = slot[f]
                 if isinstance(arr, jax.Array) and not arr.is_fully_addressable:
                     seen = set()

@@ -424,3 +424,112 @@ def test_comet_monitor_config_and_degradation():
     m = MonitorMaster(cfg)
     assert any(isinstance(x, CometMonitor) for x in m.monitors)
     m.write_events([("loss", 1.0, 1)])   # no-op when SDK missing, no raise
+
+
+def test_elastic_in_process_rejoin(tmp_path):
+    """In-process elastic recovery (reference elastic_agent.py:32, minus the
+    process restart): two OS processes train ZeRO-2; a universal snapshot is
+    taken; rank 1 is killed; rank 0 — SAME PID — tears down the distributed
+    runtime, rebuilds the mesh at world 1, reshards from the universal
+    checkpoint, and keeps training."""
+    import json
+    import socket
+    import subprocess
+    import sys
+    import textwrap
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    worker = tmp_path / "worker.py"
+    worker.write_text(textwrap.dedent("""
+        import json, os, sys, time
+        sys.path.insert(0, %r)
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import numpy as np
+        import deepspeed_tpu as ds
+        import deepspeed_tpu.comm as dist
+        from deepspeed_tpu.elasticity.rejoin import InProcessElasticWorker
+        from deepspeed_tpu.models import build_model
+        from deepspeed_tpu.utils import groups
+
+        RUN = os.environ["DS_TEST_RUN_DIR"]
+        rank = int(os.environ["RANK"])
+        pid0 = os.getpid()
+
+        dist.init_distributed(verbose=False, elastic=True,
+                              distributed_port=int(os.environ["DS_TEST_PORT"]))
+
+        def make_engine(world):
+            groups.reset_mesh()
+            model = build_model("tiny")
+            dp = len(jax.devices())
+            engine, _, _, _ = ds.initialize(model=model, config={
+                "train_batch_size": 2 * dp,
+                "train_micro_batch_size_per_gpu": 2,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "steps_per_print": 10 ** 9, "seed": 7})
+            return engine
+
+        w = InProcessElasticWorker(make_engine, os.path.join(RUN, "uckpt"),
+                                   RUN, heartbeat_timeout=3.0)
+        w.start(rank, 2)
+        engine = make_engine(2)
+        rng = np.random.default_rng(0)
+
+        def step(engine):
+            bs = engine.train_batch_size()
+            ids = rng.integers(0, 256, (bs, 16))
+            return float(engine.train_batch({"input_ids": ids, "labels": ids}))
+
+        losses = [step(engine) for _ in range(3)]
+        w.heartbeat()
+        w.save_universal(engine)
+        if rank == 1:
+            os._exit(1)                      # hard death, no cleanup
+
+        # rank 0: wait for the peer's heartbeat to go stale, then rejoin
+        deadline = time.time() + 30
+        while not w.membership_changed():
+            if time.time() > deadline:
+                raise RuntimeError("peer death never detected")
+            time.sleep(0.5)
+        engine = w.rejoin()
+        assert os.getpid() == pid0            # same process, no restart
+        assert jax.process_count() == 1
+        assert engine.global_steps == 3       # resumed from the snapshot
+        after = [step(engine) for _ in range(2)]
+        assert all(np.isfinite(after))
+        print("RESULT " + json.dumps({"losses": losses, "after": after,
+                                      "world_end": len(jax.devices())}))
+    """) % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+    env = {k: v for k, v in os.environ.items() if k != "XLA_FLAGS"}
+    env.update(MASTER_ADDR="127.0.0.1", WORLD_SIZE="2", JAX_PLATFORMS="cpu",
+               DS_TEST_PORT=str(port), DS_TEST_RUN_DIR=str(tmp_path))
+    procs = []
+    try:
+        for r in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, str(worker)], env=dict(env, RANK=str(r)),
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+        out0, _ = procs[0].communicate(timeout=300)
+        procs[1].wait(timeout=30)
+        assert procs[0].returncode == 0, out0.decode()[-2000:]
+        line = [ln for ln in out0.decode().splitlines()
+                if ln.startswith("RESULT ")][0]
+        res = json.loads(line[len("RESULT "):])
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert res["world_end"] == 2              # rank 0's two local devices
+    assert len(res["after"]) == 2
+    # training continued sanely from the snapshot
+    assert res["after"][-1] < res["losses"][0]

@@ -487,3 +487,71 @@ def test_multiprocess_sharded_host_offload(tmp_path):
         ids = rng.integers(0, 256, (16, 32))
         ref.append(float(engine.train_batch({"input_ids": ids, "labels": ids})))
     np.testing.assert_allclose(ref, stats[0]["losses"], rtol=2e-4, atol=2e-4)
+
+
+def test_cpu_adagrad_lion_native_match_device():
+    """Native host Adagrad and Lion kernels must match the device (XLA)
+    optimizer trajectories (reference csrc/adagrad/cpu_adagrad.cpp,
+    csrc/lion/cpu_lion.cpp)."""
+    from deepspeed_tpu.ops.cpu_adam_native import cpu_adagrad_step, cpu_lion_step
+    from deepspeed_tpu.ops.optimizers import FusedAdagrad, FusedLion
+
+    rng = np.random.default_rng(0)
+    p0 = rng.standard_normal(1024).astype(np.float32)
+
+    # adagrad
+    p_n, acc = p0.copy(), np.zeros_like(p0)
+    opt = FusedAdagrad(lr=1e-2, weight_decay=0.01)
+    params, state = {"x": jnp.asarray(p0)}, None
+    state = opt.init(params)
+    for _ in range(5):
+        g = rng.standard_normal(1024).astype(np.float32)
+        cpu_adagrad_step(p_n, g, acc, 1e-2, weight_decay=0.01)
+        params, state = opt.apply({"x": jnp.asarray(g)}, state, params)
+    np.testing.assert_allclose(p_n, np.asarray(params["x"]), atol=1e-5, rtol=1e-5)
+
+    # lion
+    p_n, m = p0.copy(), np.zeros_like(p0)
+    opt = FusedLion(lr=1e-3, weight_decay=0.01)
+    params, state = {"x": jnp.asarray(p0)}, None
+    state = opt.init(params)
+    for _ in range(5):
+        g = rng.standard_normal(1024).astype(np.float32)
+        cpu_lion_step(p_n, g, m, 1e-3, weight_decay=0.01)
+        params, state = opt.apply({"x": jnp.asarray(g)}, state, params)
+    np.testing.assert_allclose(p_n, np.asarray(params["x"]), atol=1e-5, rtol=1e-5)
+    np.testing.assert_allclose(m, np.asarray(state["slots"]["x"]["m"]), atol=1e-6)
+
+
+@pytest.mark.parametrize("opt_type", ["Adagrad", "Lion"])
+def test_native_host_offload_adagrad_lion(opt_type, mesh_8dp):
+    """offload_optimizer.device=cpu + native with Adagrad/Lion routes the
+    update through the matching native host kernel and tracks the on-device
+    engine (the reference's DeepSpeedCPU{Adagrad,Lion})."""
+    def run(native):
+        from deepspeed_tpu.utils import groups
+        groups.reset_mesh()
+        groups.set_mesh(groups.build_mesh(data=8))
+        model = build_model("tiny")
+        cfg = {
+            "train_batch_size": 16,
+            "optimizer": {"type": opt_type, "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "steps_per_print": 10 ** 9,
+        }
+        if native:
+            cfg["zero_optimization"]["offload_optimizer"] = {
+                "device": "cpu", "native": True}
+        engine, _, _, _ = ds.initialize(model=model, config=cfg)
+        rng = np.random.default_rng(0)
+        losses = []
+        for i in range(4):
+            ids = rng.integers(0, 256, (16, 32))
+            losses.append(float(engine.train_batch({"input_ids": ids, "labels": ids})))
+        return losses, engine
+
+    ref, _ = run(False)
+    got, engine = run(True)
+    assert engine._host_optimizer is not None
+    assert engine.optimizer.name == f"cpu_{opt_type.lower()}"
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
