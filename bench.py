@@ -79,10 +79,12 @@ def _timed_matmul_chain(m, widths, iters=10, unroll=10):
 
     run(x0, ws)  # compile+warm
     _ = jax.device_get(run(x0, ws))
-    t0 = time.perf_counter()
-    out = run(x0, ws)
-    _ = jax.device_get(out)
-    dt = time.perf_counter() - t0
+    # tunnel timing noise is +/-40% at ms scale: best-of-3 windows
+    dt = 1e9
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _ = jax.device_get(run(x0, ws))
+        dt = min(dt, time.perf_counter() - t0)
     flops = 2 * m * sum(widths[i] * widths[i + 1]
                         for i in range(len(widths) - 1)) * iters * unroll
     return flops / dt / 1e12
@@ -104,7 +106,13 @@ def measure_matmul_ceiling(platform):
     # model itself can exceed it via intra-layer independent matmuls —
     # q/k/v — overlapping; model MFU >= this chain means the step loop
     # adds no framework overhead on top of the chip's shape limits.)
-    mlp_tf = _timed_matmul_chain(8192, (768, 3072, 768))
+    # MEDIAN of 3 full measurements: single windows through the tunnel
+    # spread +/-25% even with best-of-3 timing inside (round 3 recorded a
+    # noise-deflated 0.351 ceiling that a healthy chip re-measures at
+    # ~0.39-0.44), and the ceiling anchors the headline's framing.
+    import statistics
+    mlp_tf = statistics.median(
+        _timed_matmul_chain(8192, (768, 3072, 768)) for _ in range(3))
     proj_tf = _timed_matmul_chain(8192, (768, 768))
     ideal_tf = _timed_matmul_chain(8192, (8192, 8192), iters=2, unroll=5)
     return {
@@ -114,8 +122,10 @@ def measure_matmul_ceiling(platform):
     }
 
 
-def run_train_config(name, batch, seq, dtype, zero_stage, warmup, steps):
-    """Train one config; return a result row. Failures become rows too."""
+def run_train_config(name, batch, seq, dtype, zero_stage, warmup, steps, gas=1):
+    """Train one config; return a result row. Failures become rows too.
+    ``batch`` is the GLOBAL per-chip batch; ``gas`` splits it into
+    microbatches (batch must divide by gas)."""
     import jax
     import numpy as np
 
@@ -125,14 +135,16 @@ def run_train_config(name, batch, seq, dtype, zero_stage, warmup, steps):
     n_chips = len(jax.devices())
     platform = jax.default_backend()
     row = {"model": name, "batch": batch, "seq": seq}
+    if gas > 1:
+        row["gas"] = gas
     try:
         cfg = get_config(name, max_seq_len=seq) if platform == "tpu" \
             else get_config(name)
         model = build_model(cfg.replace(dtype=dtype))
         config = {
             "train_batch_size": batch * max(1, n_chips),
-            "train_micro_batch_size_per_gpu": batch,
-            "gradient_accumulation_steps": 1,
+            "train_micro_batch_size_per_gpu": batch // gas,
+            "gradient_accumulation_steps": gas,
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 1e-4, "weight_decay": 0.01}},
             "zero_optimization": {"stage": zero_stage},
@@ -173,7 +185,19 @@ def run_train_config(name, batch, seq, dtype, zero_stage, warmup, steps):
             "zero_stage": zero_stage,
         })
     except Exception as e:  # OOM / compile failure is a result, not a crash
-        row["error"] = f"{type(e).__name__}: {str(e)[:300]}"
+        msg = str(e)
+        row["status"] = "failed"
+        row["error_type"] = type(e).__name__
+        # classify the known platform walls instead of dumping tracebacks
+        if "remote_compile" in msg and "500" in msg:
+            row["skip_reason"] = (
+                "tunnel compile-helper exhausts its memory on this config "
+                "(HTTP 500) — a platform wall, not a framework limit; the "
+                "same model compiles at smaller batch (see adjacent rows)")
+        elif "RESOURCE_EXHAUSTED" in msg or "OOM" in msg.upper():
+            row["skip_reason"] = "out of device memory at this batch"
+        else:
+            row["error"] = msg[:200]
     return row
 
 
@@ -184,9 +208,19 @@ def main():
     platform = jax.default_backend()
 
     if platform == "tpu":
-        headline_cfg = ("gpt2-small", 8, 1024, "bfloat16", 1, 5, 30)
-        sweep = [("gpt2-small", 16, 1024, "bfloat16", 1, 3, 10),
+        # micro-batch 8 is this chip's throughput sweet spot (bigger fused
+        # steps REGRESS: the single 16x1024-token step loses ~9% to XLA
+        # scheduling at that shape — see the batch-16 gas=1 vs gas=2 rows);
+        # the headline rides gas to a 64 global batch of micro-8 steps,
+        # measured best of the round-4 sweep (35.0% MFU vs 32.9% at the old
+        # batch-8 headline). batch-32 gas=1 stays unrunnable (compile-helper
+        # wall) and is recorded as a structured skip via the gas=1 row.
+        headline_cfg = ("gpt2-small", 64, 1024, "bfloat16", 1, 3, 16, 8)
+        sweep = [("gpt2-small", 8, 1024, "bfloat16", 1, 3, 10),
+                 ("gpt2-small", 16, 1024, "bfloat16", 1, 3, 10),
+                 ("gpt2-small", 16, 1024, "bfloat16", 1, 3, 10, 2),
                  ("gpt2-small", 32, 1024, "bfloat16", 1, 3, 10),
+                 ("gpt2-small", 32, 1024, "bfloat16", 1, 3, 10, 4),
                  ("gpt2-medium", 4, 1024, "bfloat16", 1, 3, 10)]
     else:
         headline_cfg = ("tiny-gpt2", 8, 128, "float32", 1, 2, 5)
@@ -198,7 +232,7 @@ def main():
         ceiling = {"matmul_ceiling_error": f"{type(e).__name__}: {str(e)[:200]}"}
     headline = run_train_config(*headline_cfg)
 
-    if "error" in headline:
+    if "error" in headline or headline.get("status") == "failed":
         # don't burn chip time on the sweep when the headline config failed
         print(json.dumps({"metric": "bench-error", "value": 0, "unit": "",
                           "vs_baseline": 0,

@@ -383,7 +383,9 @@ def main():
         mixed = (16, 256, 64)
         mixed_compiled = (16, (256, 64), 64)
         delta = (32, 512, 128)
-        delta_long = (32, 896, 128)   # full 1024-token contexts: 8 pages/seq
+        # near-full contexts (832 + 128 + 1 lookahead slot = 961 <= 1024,
+        # exactly 8 pages/seq; 896 would need a 9th page past max_seq_len)
+        delta_long = (16, 832, 128)
         medium_decode = ("gpt2-medium", 8, 128, 128)
         collapse = (128, 64)
     else:   # dev smoke

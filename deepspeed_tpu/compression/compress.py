@@ -16,13 +16,51 @@ from ..utils.logging import logger
 
 def fake_quantize(w, bits: int = 8, symmetric: bool = True):
     """Quantization-aware fake-quant (reference QuantAct/LinearLayer_Compress):
-    round-trip through the integer grid, straight-through in backward."""
+    round-trip through the integer grid, straight-through in backward.
+    ``bits=1`` binarizes and ``bits=2`` ternarizes (the XTC extreme-
+    compression grid, reference ``basic_layer.py`` Binary/TernaryQuantizer)."""
+    if bits == 1:
+        return binarize(w)
+    if bits == 2:
+        return ternarize(w)
     qmax = 2 ** (bits - 1) - 1
     scale = jnp.maximum(jnp.max(jnp.abs(w)), 1e-10) / qmax
     q = jnp.clip(jnp.round(w / scale), -qmax, qmax)
     deq = q * scale
     # straight-through estimator: identity gradient
     return w + jax.lax.stop_gradient(deq - w)
+
+
+def binarize(w):
+    """XTC 1-bit weights: sign(w) scaled by the per-output-channel mean
+    magnitude (reference BinaryQuantizer / BWN), straight-through backward."""
+    axis = tuple(range(w.ndim - 1)) if w.ndim > 1 else None
+    scale = jnp.mean(jnp.abs(w), axis=axis, keepdims=w.ndim > 1)
+    deq = jnp.sign(jnp.where(w == 0, 1.0, w)) * scale
+    return w + jax.lax.stop_gradient(deq - w)
+
+
+def ternarize(w):
+    """XTC 2-bit (ternary) weights: {-a, 0, +a} with the TWN threshold
+    0.7 * mean|w| and a = mean magnitude of the surviving weights
+    (reference TernaryQuantizer), straight-through backward."""
+    axis = tuple(range(w.ndim - 1)) if w.ndim > 1 else None
+    thr = 0.7 * jnp.mean(jnp.abs(w), axis=axis, keepdims=w.ndim > 1)
+    mask = (jnp.abs(w) > thr).astype(w.dtype)
+    denom = jnp.maximum(jnp.sum(mask, axis=axis, keepdims=w.ndim > 1), 1.0)
+    a = jnp.sum(jnp.abs(w) * mask, axis=axis, keepdims=w.ndim > 1) / denom
+    deq = jnp.sign(w) * mask * a
+    return w + jax.lax.stop_gradient(deq - w)
+
+
+def fake_quantize_activation(x, bits: int = 8):
+    """Activation fake-quant (reference QuantAct): dynamic symmetric
+    per-tensor scale from the running batch, straight-through backward.
+    Used by models with ``act_quant_bits`` set (QAT for W+A quantization)."""
+    qmax = 2 ** (bits - 1) - 1
+    scale = jnp.maximum(jnp.max(jnp.abs(jax.lax.stop_gradient(x))), 1e-10) / qmax
+    q = jnp.clip(jnp.round(x / scale), -qmax, qmax) * scale
+    return x + jax.lax.stop_gradient(q - x)
 
 
 def magnitude_prune(w, sparsity: float):
@@ -64,7 +102,13 @@ def _apply_to_params(params, fn, patterns, prefix=""):
 def init_compression(model_or_params, deepspeed_config: Dict, teacher_model=None,
                      mpu=None):
     """Apply the compression config to a param pytree (reference
-    init_compression). Returns transformed params."""
+    init_compression). Returns transformed params.
+
+    Distillation is a MODEL transform, not a param transform: wrap the
+    student with ``distillation.DistilledModel.from_config`` (the
+    ``knowledge_distillation`` config block) and feed batches through
+    ``make_teacher_provider``. Activation QAT is a model-config switch
+    (``act_quant_bits``)."""
     params = model_or_params
     comp = deepspeed_config.get("compression_training", {})
 
@@ -122,8 +166,12 @@ def redundancy_clean(model_or_params, deepspeed_config: Dict, mpu=None):
 # configs for init_compression / CompressionScheduler — start points users
 # tune, mirroring the reference's config_templates.
 
-def xtc_recipe(keep_number_layer=6, start_bits=1, schedule_offset=2000):
-    """Extreme compression (XTC): deep layer reduction + 1-bit weights."""
+def xtc_recipe(keep_number_layer=6, start_bits=1, schedule_offset=2000,
+               kd_alpha=0.7, kd_temperature=2.0):
+    """Extreme compression (XTC): deep layer reduction + binarized (1-bit)
+    weights + a knowledge-distillation stage (the reference XTC pipeline:
+    reduce, binarize past the offset, distill from the uncompressed
+    teacher)."""
     return {"compression_training": {
         "layer_reduction": {"enabled": True,
                             "keep_number_layer": keep_number_layer},
@@ -132,6 +180,8 @@ def xtc_recipe(keep_number_layer=6, start_bits=1, schedule_offset=2000):
                                   "schedule_offset": schedule_offset},
             "different_groups": {"xtc_w": {"params": {"start_bits": start_bits},
                                            "modules": ["attn", "mlp"]}}},
+        "knowledge_distillation": {"enabled": True, "alpha": kd_alpha,
+                                   "temperature": kd_temperature},
     }}
 
 

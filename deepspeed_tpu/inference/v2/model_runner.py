@@ -83,7 +83,10 @@ class PagedModelRunner:
         blk = jnp.where(is_pad, 0, jnp.take_along_axis(
             block_tables, pos_safe // bs, axis=1))          # (B, C)
         off = pos_safe % bs
-        seq_lens_after = jnp.max(jnp.where(is_pad, 0, positions + 1), axis=1)
+        # first chunk position per row: pool slots >= this are stale (the
+        # chunk's KV flows beside the pool, committed after the layer walk)
+        chunk_start = jnp.min(jnp.where(is_pad, 1 << 30, positions),
+                              axis=1).astype(jnp.int32)
 
         windows = model._layer_windows()   # (L,) for local/global patterns
         uniform_window = None
@@ -92,9 +95,12 @@ class PagedModelRunner:
             uniform_window = cfg.sliding_window   # binds within this pool
 
         def layer(h, xs, tag=None):
-            lp, kp, vp, win = xs
+            lp, l, win = xs
             if win is None:
                 win = uniform_window
+            if cfg.act_quant_bits:   # QAT models serve with quantized acts
+                from ...compression.compress import fake_quantize_activation
+                h = fake_quantize_activation(h, cfg.act_quant_bits)
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
             k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
@@ -111,29 +117,39 @@ class PagedModelRunner:
                                  interleaved=cfg.rope_interleaved)
                 k = L.apply_rope(k, pos_safe, inv_freq,
                                  interleaved=cfg.rope_interleaved)
-            kp = kp.at[:, blk, off].set(k.astype(kp.dtype).transpose(2, 0, 1, 3))
-            vp = vp.at[:, blk, off].set(v.astype(vp.dtype).transpose(2, 0, 1, 3))
+            # the pools are LOOP-INVARIANT inside the layer scan: this
+            # layer's chunk KV rides into the attention as separate blocks
+            # and comes back out as scan ys; one token-sized scatter after
+            # the walk commits every layer at once. (Both alternatives
+            # measured pool-size-bound: scanning per-layer pool slices as
+            # xs/ys restacks the pools every step, and scattering into a
+            # carried full pool makes XLA copy it defensively around the
+            # kernel's read.)
             if _use_pallas_paged():
                 # decode AND chunked prefill read pages in place (no
                 # gather); causal masking, sliding windows (uniform or
                 # per-layer traced), ALiBi, and attention softcapping all
-                # run in-kernel (the FastGen blocked-flash surface)
+                # run in-kernel (the FastGen blocked-flash surface); the
+                # kernel indexes (layer, head, page) in the full pool
                 from ...ops.pallas.paged_attention import paged_ragged_attention
                 slopes = (L.alibi_slopes(cfg.num_heads)
                           if cfg.position == "alibi" else None)
                 out = paged_ragged_attention(
-                    q, kp, vp, block_tables, positions,
+                    q, kpool, vpool, block_tables, positions, k, v, layer=l,
                     scale=cfg.attn_scale, window=win, alibi_slopes=slopes,
                     softcap=cfg.attn_softcap)
             else:
-                kpages = kp[:, block_tables].reshape(
+                kl = jnp.take(kpool, l, axis=0)   # escape hatch: copies 1/L
+                vl = jnp.take(vpool, l, axis=0)
+                kpages = kl[:, block_tables].reshape(
                     cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
-                vpages = vp[:, block_tables].reshape(
+                vpages = vl[:, block_tables].reshape(
                     cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
                 # per-query causal mask via positions: query at position p
                 # sees cache slots [0, p]; masks by slot index.
                 out = _paged_attention(q, kpages, vpages, positions, cfg,
-                                       window=win)
+                                       window=win, chunk_k=k, chunk_v=v,
+                                       chunk_start=chunk_start)
             y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
             if "bo" in lp["attn"]:   # presence-keyed: out_bias may differ from use_bias
                 y = y + lp["attn"]["bo"].astype(dt)
@@ -150,31 +166,39 @@ class PagedModelRunner:
                 mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
             if cfg.sandwich_norm:
                 mlp_out = L.apply_norm(lp["norm4"], mlp_out, cfg)
-            if cfg.parallel_block:
-                return h + y + mlp_out, (kp, vp)
-            return h + mlp_out, (kp, vp)
+            h = h + y + mlp_out if cfg.parallel_block else h + mlp_out
+            return h, (k.astype(kpool.dtype), v.astype(vpool.dtype))
 
-        h, kpool, vpool = self._run_layers(layer, h, params, kpool, vpool, windows)
+        h, kpool, vpool = self._run_layers(layer, h, params, kpool, vpool,
+                                           windows, blk, off)
         h = L.apply_norm(params["final_norm"], h, cfg)
         return self._head(params, h, valid_counts), kpool, vpool
 
-    def _run_layers(self, layer, h, params, kpool, vpool, windows):
+    def _run_layers(self, layer, h, params, kpool, vpool, windows, blk, off):
         """Drive ``layer`` over the stack following the model's layer plan
-        (heterogeneous stacks: Qwen2-MoE sparse steps, mlp_only prefixes),
-        with the KV pools' layer axis sliced to match the grouped param
-        layout. The plan walk itself lives in
-        ``models/transformer.py walk_layer_plan`` — shared with the train
-        forward and the cached decode so the three paths cannot diverge."""
+        (heterogeneous stacks: Qwen2-MoE sparse steps, mlp_only prefixes).
+        The full pools stay loop-invariant (read through a global layer
+        index, never a materialized per-layer slice); each layer's chunk KV
+        returns as scan ys and is committed with ONE token-sized scatter.
+        Per-layer xs are (layer index, window), which the shared
+        ``walk_layer_plan`` driver slices to match the grouped param layout
+        exactly like the train forward and the cached decode."""
         from ...models.transformer import walk_layer_plan
         model = self.model
+        layer_ids = jnp.arange(self.cfg.num_layers, dtype=jnp.int32)
 
         def body(h, lp, xs_t, tag):
-            kp, vp, win = xs_t
-            return layer(h, (lp, kp, vp, win), tag=tag)
+            l, win = xs_t
+            return layer(h, (lp, l, win), tag=tag)
 
-        h, (kpool, vpool) = walk_layer_plan(
+        h, (ck_all, cv_all) = walk_layer_plan(
             model._plan, model._groups, params["layers"],
-            (kpool, vpool, windows), h, body)
+            (layer_ids, windows), h, body)
+        # (L, B, C, KVH, D) chunk KV → pool[:, :, blk, off]: the advanced
+        # (B, C) indices are contiguous, so the indexed window is
+        # (L, KVH, B, C, D)
+        kpool = kpool.at[:, :, blk, off].set(ck_all.transpose(0, 3, 1, 2, 4))
+        vpool = vpool.at[:, :, blk, off].set(cv_all.transpose(0, 3, 1, 2, 4))
         return h, kpool, vpool
 
     def _head(self, params, h, valid_counts):
@@ -325,11 +349,24 @@ class PagedModelRunner:
         return self._fns[chunk](*args)
 
 
-def _paged_attention(q, kpages, vpages, positions, cfg, window=None):
+def _paged_attention(q, kpages, vpages, positions, cfg, window=None,
+                     chunk_k=None, chunk_v=None, chunk_start=None):
     """q: (B, C, H, D); kpages/vpages: (B, S_pad, KVH, D); positions: (B, C)
     absolute slot of each query (−1 = pad). Query at slot p attends slots ≤ p.
-    ``window``: sliding-window width (may be traced; <= 0 = global)."""
+    ``window``: sliding-window width (may be traced; <= 0 = global).
+    ``chunk_k/chunk_v``: (B, C, KVH, D) the current chunk's own KV — the
+    pool slots >= ``chunk_start`` (B,) are stale and masked; the chunk keys
+    attend at key positions = ``positions``."""
     h = q.shape[2]
+    s_pad = kpages.shape[1]
+    k_pos = jnp.arange(s_pad)[None, :] * jnp.ones(
+        (q.shape[0], 1), jnp.int32)                     # (B, S_pad)
+    if chunk_k is not None:
+        kpages = jnp.concatenate([kpages, chunk_k.astype(kpages.dtype)], axis=1)
+        vpages = jnp.concatenate([vpages, chunk_v.astype(vpages.dtype)], axis=1)
+        k_pos = jnp.concatenate([
+            jnp.where(k_pos < chunk_start[:, None], k_pos, -1),
+            jnp.where(positions >= 0, positions, -1)], axis=1)
     kvh = kpages.shape[2]
     if kvh != h:
         rep = h // kvh
@@ -340,19 +377,20 @@ def _paged_attention(q, kpages, vpages, positions, cfg, window=None):
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kpages,
                         preferred_element_type=jnp.float32) * scale
     if cfg.position == "alibi":
-        # gathered page slot index IS the absolute sequence position
-        logits = logits + L.alibi_bias(
-            cfg.num_heads, jnp.maximum(positions, 0), jnp.arange(kpages.shape[1]))
+        # key position (gathered slot / chunk position) relative to query
+        logits = logits + (L.alibi_slopes(cfg.num_heads)[None, :, None, None]
+                           * (k_pos[:, None, None, :].astype(jnp.float32)
+                              - jnp.maximum(positions, 0)[:, None, :, None]))
     # softcap AFTER the bias — the order the Pallas kernel and
     # reference_attention use (ALiBi and softcapping never co-occur in the
     # supported families, but the two paths must stay bit-comparable)
     if cfg.attn_softcap:
         logits = cfg.attn_softcap * jnp.tanh(logits / cfg.attn_softcap)
-    k_pos = jnp.arange(kpages.shape[1])[None, None, :]
-    mask = k_pos <= positions[:, :, None]               # (B, C, S_pad); pad rows all-False
+    kp = k_pos[:, None, :]                               # (B, 1, S_total)
+    mask = (kp >= 0) & (kp <= positions[:, :, None])     # pad keys/rows dead
     if window is not None:
         from ...ops.attention import window_mask
-        mask = mask & window_mask(positions[:, :, None], k_pos, window)
+        mask = mask & window_mask(positions[:, :, None], kp, window)
     logits = jnp.where(mask[:, None], logits, jnp.finfo(jnp.float32).min)
     # pad queries have no visible keys: softmax over -inf row → uniform; their
     # outputs are discarded by the caller, and max-subtraction keeps it finite.

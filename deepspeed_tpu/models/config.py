@@ -99,6 +99,10 @@ class TransformerConfig:
     attn_impl: str = "auto"
     # remat policy for scan-over-layers ("none"|"full"|"dots")
     remat: str = "none"
+    # QAT activation fake-quant bits (compression QuantAct analog): each
+    # layer's attention/MLP inputs round-trip an int grid with an STE
+    # backward; 0 disables
+    act_quant_bits: int = 0
     # vocab-chunked fused cross-entropy (ops/cross_entropy.py): number of
     # lm-head chunks; 0 disables. Engaged when the (B, S, V) logits would
     # exceed loss_chunk_threshold_bytes — the fused path trades one extra

@@ -80,6 +80,14 @@ def _remat_policy(name: str):
         return jax.checkpoint_policies.checkpoint_dots
     if name == "dots_no_batch":
         return jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    if name == "dots_offload":
+        # the reference's cpu_checkpointing (activation checkpoints parked
+        # in host memory, runtime/activation_checkpointing/checkpointing.py
+        # partition+cpu variants): matmul outputs are saved but OFFLOADED to
+        # pinned host memory, streamed back for the backward — activation
+        # residency on device drops to the live layer
+        return jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
     return None
 
 
@@ -311,6 +319,11 @@ class CausalLM:
                   layer_type=None):
         cfg = self.cfg
         is_moe = cfg.is_moe if layer_type is None else layer_type == "moe"
+        if cfg.act_quant_bits:
+            # QAT activation quantization (compression QuantAct analog):
+            # the layer input round-trips the int grid, STE backward
+            from ..compression.compress import fake_quantize_activation
+            h = fake_quantize_activation(h, cfg.act_quant_bits)
         if cfg.post_norm:
             # BERT block: norm AFTER each residual add, attention reads the
             # raw stream
@@ -410,7 +423,10 @@ class CausalLM:
         from ..parallel.sharding import current_manual_axes
         manual = current_manual_axes()
         if manual:
-            aux0 = jax.lax.pvary(aux0, tuple(manual))
+            if hasattr(jax.lax, "pcast"):
+                aux0 = jax.lax.pcast(aux0, tuple(manual), to="varying")
+            else:
+                aux0 = jax.lax.pvary(aux0, tuple(manual))
         carry = (h, aux0)
 
         def make_body(fn):
@@ -484,6 +500,9 @@ class CausalLM:
 
         def dec_layer(lp, h, ck, cv, win, tag=None):
             is_moe = cfg.is_moe if tag is None else tag == "moe"
+            if cfg.act_quant_bits:   # QAT: decode must match the forward
+                from ..compression.compress import fake_quantize_activation
+                h = fake_quantize_activation(h, cfg.act_quant_bits)
             a_in = L.apply_norm(lp["norm1"], h, cfg)
             attn_out, kv = L.apply_attention(lp["attn"], a_in, cfg, positions=positions,
                                              inv_freq=self._inv_freq,

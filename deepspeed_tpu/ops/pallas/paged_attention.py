@@ -31,8 +31,9 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _paged_kernel(bt_ref, len_ref, lo_ref, win_ref,   # scalar prefetch
-                  q_ref, k_ref, v_ref, pos_ref, slope_ref,   # blocks
+def _paged_kernel(lyr_ref, bt_ref, cs_ref, lo_ref, win_ref,   # scalar prefetch
+                  q_ref, k_ref, v_ref, pos_ref, slope_ref,
+                  ck_ref, cv_ref, cpos_ref,       # current-chunk KV blocks
                   o_ref,                          # output
                   m_ref, l_ref, acc_ref,          # VMEM scratch
                   *, page_size, pages_max, scale, softcap, use_alibi):
@@ -45,71 +46,107 @@ def _paged_kernel(bt_ref, len_ref, lo_ref, win_ref,   # scalar prefetch
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    seq_len = len_ref[b]
     win = win_ref[0]          # runtime: 0/negative = global (per-layer
     # window patterns arrive as traced scan elements, so the window cannot
     # be a compile-time constant)
-    # pages entirely below every query's window are dead (lo_ref
-    # pre-computes the lowest visible slot; 0 when global)
-    active = jnp.logical_and(j * page_size < seq_len,
-                             (j + 1) * page_size > lo_ref[b])
+    pos = pos_ref[0, 0].reshape(-1, 1)                    # (R, 1) f32
+    wf = win.astype(jnp.float32)
 
-    @pl.when(active)
-    def _page():
-        q = q_ref[0, 0]                                   # (R, D) R = C*G
-        k = k_ref[0, 0]                                   # (bs, D)
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)   # (R, bs)
-        if scale != 1.0:
-            s = s * scale
-        slot = (j * page_size
-                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)).astype(jnp.float32)
-        pos = pos_ref[0, 0].reshape(-1, 1)                # (R, 1) f32
-        if use_alibi:
-            # slope block is already this kv-head's (1, 1, R) slice
-            s = s + slope_ref[0, 0].reshape(-1, 1) * (slot - pos)
-        if softcap:
-            s = softcap * jnp.tanh(s / softcap)
-        mask = slot <= pos
-        wf = win.astype(jnp.float32)
-        mask = jnp.logical_and(mask,
-                               jnp.logical_or(win <= 0, slot > pos - wf))
+    def online_update(s, mask, v):
         s = jnp.where(mask, s, NEG_INF)
         m_prev = m_ref[...]
         l_prev = l_ref[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        l_ref[...] = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_ref[...] = m_new
-        l_ref[...] = l_new
+
+    def scores(q, k, key_pos):
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if scale != 1.0:
+            s = s * scale
+        if use_alibi:
+            # slope block is already this kv-head's (1, 1, R) slice
+            s = s + slope_ref[0, 0].reshape(-1, 1) * (key_pos - pos)
+        if softcap:
+            s = softcap * jnp.tanh(s / softcap)
+        mask = key_pos <= pos
+        mask = jnp.logical_and(mask,
+                               jnp.logical_or(win <= 0, key_pos > pos - wf))
+        return s, mask
+
+    # pool slots >= cs (the current chunk's first position) are stale: the
+    # chunk's own KV arrives as separate blocks below, NOT via the pool —
+    # keeping the pool read-only inside the layer scan is what lets XLA
+    # leave it in place (a scattered-then-read pool forces pool-sized
+    # defensive copies; measured pool-size-bound decode)
+    active = jnp.logical_and(j * page_size < cs_ref[b],
+                             (j + 1) * page_size > lo_ref[b])
+
+    @pl.when(active)
+    def _page():
+        q = q_ref[0, 0]                                   # (R, D) R = C*G
+        k = k_ref[0, 0, 0]                                # (bs, D)
+        v = v_ref[0, 0, 0]
+        slot = (j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], page_size), 1)).astype(jnp.float32)
+        s, mask = scores(q, k, slot)
+        mask = jnp.logical_and(mask, slot < cs_ref[b].astype(jnp.float32))
+        online_update(s, mask, v)
 
     @pl.when(j == pages_max - 1)
-    def _finalize():
+    def _chunk_and_finalize():
+        q = q_ref[0, 0]
+        ck = ck_ref[0, 0]                                 # (C, D)
+        cv = cv_ref[0, 0]
+        kpos = cpos_ref[0, 0].reshape(1, -1)              # (1, C) f32; -1 pad
+        s, mask = scores(q, ck, kpos)
+        mask = jnp.logical_and(mask, kpos >= 0)           # pad keys dead
+        online_update(s, mask, cv)
         l_safe = jnp.where(l_ref[...] == 0.0, 1.0, l_ref[...])
         o_ref[0, 0] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
 
 
-def paged_ragged_attention(q, kpool, vpool, block_tables, positions, *,
+def paged_ragged_attention(q, kpool, vpool, block_tables, positions,
+                           chunk_k=None, chunk_v=None, *, layer=None,
                            scale=None, window=0, alibi_slopes=None,
                            softcap=0.0):
     """Unified paged attention for decode AND chunked prefill.
 
     q: (B, C, H, D) — C query tokens per sequence (1 = decode);
-    kpool/vpool: (KVH, NB, bs, D) kv-head-major page pools (chunk KV already
-    scattered in); block_tables: (B, MB) int32 page ids; positions: (B, C)
+    kpool/vpool: the FULL (L, KVH, NB, bs, D) kv-head-major page pools with
+    ``layer`` the (traced) layer index — the kernel's BlockSpec chases
+    (layer, head, page) directly, so no per-layer pool slice is ever
+    materialized. A 4-D (KVH, NB, bs, D) single-layer pool with
+    ``layer=None`` is also accepted. The pools are READ-ONLY here and must
+    NOT yet contain the current chunk: ``chunk_k``/``chunk_v`` (B, C, KVH,
+    D) carry the chunk's own KV, processed as a final virtual page with
+    per-key positions = ``positions`` (pool slots >= the chunk's first
+    position are treated as stale and masked). This keeps the pool
+    loop-invariant across the layer scan — the caller scatters all layers'
+    chunk KV in one token-sized update afterwards. With ``chunk_k=None``
+    the pool is taken as ALREADY containing every slot up to each query's
+    position (the pre-round-4 contract, kept for the v1 fused-decode path).
+
+    block_tables: (B, MB) int32 page ids; positions: (B, C)
     int32 absolute slot of each query, -1 for padding rows (their outputs
     are garbage the caller discards). Query at slot p attends slots <= p,
     within (p - window, p] when ``window`` > 0; ``alibi_slopes``: (H,)
     per-head slopes applied in-kernel; ``softcap``: Gemma-2 attention-logit
     tanh cap. Returns (B, C, H, D).
     """
+    if kpool.ndim == 4:
+        kpool = kpool[None]
+        vpool = vpool[None]
+        layer = 0
     b, c, h, d = q.shape
-    kvh, nb, page_size, _ = kpool.shape
+    _, kvh, nb, page_size, _ = kpool.shape
+    lyr = jnp.asarray(layer, jnp.int32).reshape(1)
     mb = block_tables.shape[1]
     group = h // kvh
     rows = c * group
@@ -126,9 +163,23 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions, *,
     pos_rep = jnp.repeat(positions, group, axis=1).astype(jnp.float32)
     pos_rep = pos_rep.reshape(b, 1, rows)
     valid = positions >= 0
-    seq_lens = (jnp.max(jnp.where(valid, positions, -1), axis=1) + 1).astype(jnp.int32)
     win_arr = jnp.asarray(window, jnp.int32).reshape(1)
     minpos = jnp.min(jnp.where(valid, positions, 1 << 30), axis=1)
+    if chunk_k is not None:
+        # chunk KV → (B, KVH, C, D) blocks + (B, 1, C) f32 key positions;
+        # pool is valid only BELOW the chunk's first position
+        ckg = chunk_k.astype(q.dtype).transpose(0, 2, 1, 3)
+        cvg = chunk_v.astype(q.dtype).transpose(0, 2, 1, 3)
+        cpos = positions.astype(jnp.float32).reshape(b, 1, c)
+        # fully-padded rows have no valid positions: zero pages, not 2^30
+        chunk_start = jnp.where(minpos == 1 << 30, 0, minpos).astype(jnp.int32)
+    else:
+        # pool already holds every slot <= pos; dead chunk blocks
+        ckg = jnp.zeros((b, kvh, c, d), q.dtype)
+        cvg = ckg
+        cpos = jnp.full((b, 1, c), -1.0, jnp.float32)
+        chunk_start = (jnp.max(jnp.where(valid, positions, -1), axis=1)
+                       + 1).astype(jnp.int32)
     lo = jnp.where(win_arr[0] > 0,
                    jnp.maximum(minpos - win_arr[0] + 1, 0),
                    0).astype(jnp.int32)
@@ -142,31 +193,37 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions, *,
 
     grid = (b, kvh, mb)
 
-    def q_map(bi, hi, ji, bt, lens, lo_, w_):
+    def q_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
         return (bi, hi, 0, 0)
 
-    def kv_map(bi, hi, ji, bt, lens, lo_, w_):
-        return (hi, bt[bi, ji], 0, 0)
+    def kv_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
+        return (lyr_[0], hi, bt[bi, ji], 0, 0)
 
-    def pos_map(bi, hi, ji, bt, lens, lo_, w_):
+    def pos_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
         return (bi, 0, 0)
 
-    def slope_map(bi, hi, ji, bt, lens, lo_, w_):
+    def slope_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
         return (hi, 0, 0)
+
+    def chunk_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
+        return (bi, hi, 0, 0)
 
     out = pl.pallas_call(
         functools.partial(_paged_kernel, page_size=page_size, pages_max=mb,
                           scale=scale, softcap=softcap,
                           use_alibi=use_alibi),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=4,
+            num_scalar_prefetch=5,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, rows, d), q_map),
-                pl.BlockSpec((1, 1, page_size, d), kv_map),
-                pl.BlockSpec((1, 1, page_size, d), kv_map),
+                pl.BlockSpec((1, 1, 1, page_size, d), kv_map),
+                pl.BlockSpec((1, 1, 1, page_size, d), kv_map),
                 pl.BlockSpec((1, 1, rows), pos_map),
                 pl.BlockSpec((1, 1, rows), slope_map),
+                pl.BlockSpec((1, 1, c, d), chunk_map),
+                pl.BlockSpec((1, 1, c, d), chunk_map),
+                pl.BlockSpec((1, 1, c), pos_map),
             ],
             out_specs=pl.BlockSpec((1, 1, rows, d), q_map),
             scratch_shapes=[
@@ -179,7 +236,8 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions, *,
         interpret=jax.default_backend() != "tpu",
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-    )(block_tables, seq_lens, lo, win_arr, qg, kpool, vpool, pos_rep, slopes)
+    )(lyr, block_tables, chunk_start, lo, win_arr, qg, kpool, vpool, pos_rep,
+      slopes, ckg, cvg, cpos)
     # (B, KVH, C*G, D) → (B, C, H, D)
     return out.reshape(b, kvh, c, group, d).transpose(0, 2, 1, 3, 4).reshape(
         b, c, h, d)
