@@ -23,15 +23,21 @@ import jax
 import jax.numpy as jnp
 
 
-def kd_loss(student_logits, teacher_logits, temperature: float = 1.0):
-    """Soft-target KL: T^2 * mean_t KL(softmax(t/T) || softmax(s/T))."""
+def kd_loss(student_logits, teacher_logits, temperature: float = 1.0,
+            loss_mask=None):
+    """Soft-target KL: T^2 * mean_t KL(softmax(t/T) || softmax(s/T)).
+    ``loss_mask`` weights positions exactly like the CE term (pad/prompt
+    tokens must not pull the student toward the teacher)."""
     t = jnp.asarray(temperature, jnp.float32)
     sl = student_logits.astype(jnp.float32) / t
     tl = teacher_logits.astype(jnp.float32) / t
     p_t = jax.nn.softmax(tl, axis=-1)
     kl = jnp.sum(p_t * (jax.nn.log_softmax(tl, axis=-1)
                         - jax.nn.log_softmax(sl, axis=-1)), axis=-1)
-    return (t * t) * jnp.mean(kl)
+    if loss_mask is None:
+        return (t * t) * jnp.mean(kl)
+    m = loss_mask.astype(jnp.float32)
+    return (t * t) * jnp.sum(kl * m) / jnp.maximum(jnp.sum(m), 1.0)
 
 
 class DistilledModel:
@@ -76,7 +82,8 @@ class DistilledModel:
         cfg = self.student.cfg
         if cfg.is_moe:
             ce = ce + cfg.moe_aux_loss_coef * aux
-        kd = kd_loss(s_logits, teacher_logits, self.temperature)
+        kd = kd_loss(s_logits, teacher_logits, self.temperature,
+                     loss_mask=batch.get("loss_mask"))
         return (1.0 - self.alpha) * ce + self.alpha * kd
 
 

@@ -83,3 +83,26 @@ ALL_OPS = {
 }
 
 __all__ = ["OpBuilder", "PallasOpBuilder", "NativeOpBuilder", "ALL_OPS"] + list(ALL_OPS.keys())
+
+
+def build_all(verbose: bool = True, ops=None):
+    """Ahead-of-time build of every (compatible) op — the analog of the
+    reference's prebuild path (``DS_BUILD_OPS=1`` install, builder.py:513):
+    native extensions are compiled into the build cache NOW instead of at
+    first use, so multi-process launches don't race the JIT compile and
+    air-gapped deploys ship warm caches. Returns {name: "ok" | "skipped:
+    <why>" | "failed: <err>"}."""
+    results = {}
+    for cls_name, cls in ALL_OPS.items():
+        if ops and cls_name not in ops:
+            continue
+        b = cls()
+        if not b.is_compatible(verbose=verbose):
+            results[b.name] = f"skipped: {b.error_log or 'incompatible'}"
+            continue
+        try:
+            b.load(verbose=verbose)
+            results[b.name] = "ok"
+        except Exception as e:
+            results[b.name] = f"failed: {str(e)[:200]}"
+    return results

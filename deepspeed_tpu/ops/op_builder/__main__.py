@@ -1,0 +1,32 @@
+"""``python -m deepspeed_tpu.ops.op_builder`` — prebuild + report.
+
+Analog of the reference's ``ds_report`` op table + ``DS_BUILD_OPS``
+prebuild: probes every registered builder, compiles the native ones
+ahead of time, and prints one status line per op. Exits nonzero if an op
+named via ``--op`` fails to build.
+"""
+
+import argparse
+import sys
+
+from . import ALL_OPS, build_all
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description="Prebuild deepspeed_tpu ops")
+    ap.add_argument("--op", action="append", default=None,
+                    help="builder class name (repeatable); default: all")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+    results = build_all(verbose=not args.quiet, ops=args.op)
+    width = max(len(n) for n in results)
+    rc = 0
+    for name, status in results.items():
+        print(f"{name:<{width}}  {status}")
+        if args.op and not status.startswith(("ok", "skipped")):
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

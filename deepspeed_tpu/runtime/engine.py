@@ -290,10 +290,6 @@ class DeepSpeedEngine:
         ``runtime/zero/infinity.py``) and the subset of engine services it
         needs. The compiled-step path is not built in this mode."""
         from .zero.infinity import InfinityRunner
-        if self._config.fp16.enabled:
-            raise NotImplementedError(
-                "ZeRO-Infinity streaming supports bf16/fp32 only; fp16 loss "
-                "scaling is not applied on this path")
         opt_cfg = self._config.optimizer
         hyper = dict(opt_cfg.params) if opt_cfg and opt_cfg.params else {"lr": 1e-3}
         nvme = None
@@ -333,14 +329,22 @@ class DeepSpeedEngine:
 
     def _maybe_override_model_dtype(self):
         from ..models.transformer import CausalLM
-        if isinstance(self.model, CausalLM):
+        # overrides must land on the object whose forward READS cfg: for
+        # wrappers delegating to a CausalLM (DistilledModel) that is the
+        # wrapped student, not the wrapper (setting wrapper.cfg would
+        # shadow-attribute it and silently change nothing)
+        target = self.model
+        if not isinstance(target, CausalLM) and isinstance(
+                getattr(target, "student", None), CausalLM):
+            target = target.student
+        if isinstance(target, CausalLM):
             dt = self._config.precision_dtype
             name = {jnp.float16: "float16", jnp.bfloat16: "bfloat16"}.get(dt)
-            if name and self.model.cfg.dtype != name:
-                self.model.cfg = self.model.cfg.replace(dtype=name)
+            if name and target.cfg.dtype != name:
+                target.cfg = target.cfg.replace(dtype=name)
             ac = self._config.activation_checkpointing
-            if ac.policy != "none" and self.model.cfg.remat == "none":
-                self.model.cfg = self.model.cfg.replace(remat=ac.policy)
+            if ac.policy != "none" and target.cfg.remat == "none":
+                target.cfg = target.cfg.replace(remat=ac.policy)
 
     def _configure_optimizer(self, client_optimizer) -> Optimizer:
         opt = self._build_base_optimizer(client_optimizer)
@@ -1273,12 +1277,16 @@ class DeepSpeedEngine:
         ``batch`` leaves: (gas * micro_bs, ...) or (gas, micro_bs, ...).
         """
         if self._infinity is not None:
-            if self.gradient_accumulation_steps() != 1:
-                raise NotImplementedError(
-                    "ZeRO-Infinity streaming does not support gradient accumulation yet")
+            gas = self.gradient_accumulation_steps()
             self.tput_timer.start()
-            loss = self._infinity.train_batch(batch, lr=float(self._next_lr()))
-            self.micro_steps += 1
+            scale = float(jax.device_get(self.scaler_state.scale))
+            loss, overflow = self._infinity.train_batch(
+                batch, lr=float(self._next_lr()), gas=gas, loss_scale=scale)
+            self.scaler_state = self.loss_scaler.update(
+                self.scaler_state, jnp.asarray(overflow))
+            if overflow:
+                self.skipped_steps += 1
+            self.micro_steps += gas
             self.global_steps += 1
             self.global_samples += self.train_batch_size()
             self.tput_timer.stop(global_step=True)

@@ -115,15 +115,10 @@ def check_pipeline_model_support(cfg):
         raise NotImplementedError(
             "pipeline engine supports causal pre-norm decoders only; "
             "train BERT-style encoders under ZeRO (DP/TP/SP) instead")
-    if getattr(cfg, "sliding_window", None) is not None \
-            and getattr(cfg, "local_attention_every", None) or \
-            getattr(cfg, "window_pattern", None):
-        raise NotImplementedError(
-            "per-layer local/global attention patterns are not threaded "
-            "through pipeline stages; uniform sliding_window is supported")
-    # heterogeneous stacks (cfg.layer_types) are supported by the 1F1B
-    # engine via per-stage slot tables (see build_pipeline_1f1b); the GPipe
-    # autodiff path keeps its own guard in build_pipeline_loss.
+    # heterogeneous stacks (cfg.layer_types) and per-layer local/global
+    # window patterns are supported by the 1F1B engine via per-stage slot
+    # tables (see build_pipeline_1f1b); the GPipe autodiff path keeps its
+    # own guards in build_pipeline_loss.
 
 
 def _pipeline_interface(model):
@@ -137,15 +132,15 @@ def _pipeline_interface(model):
     if hasattr(model, "pipe_embed"):
         raw = model.pipe_layer
 
-        def custom_layer(lp, h, tag=None):   # tag unused; no aux loss in
-            return raw(lp, h), jnp.zeros((), jnp.float32)   # custom protocol
+        def custom_layer(lp, h, tag=None, win=None):   # tag/win unused; no
+            return raw(lp, h), jnp.zeros((), jnp.float32)   # aux in custom
         return model.pipe_embed, custom_layer, model.pipe_loss
 
     def embed(other, batch_mb):
         return model.embed_fwd(other["embed"], batch_mb["input_ids"])
 
-    def layer(lp, h, tag=None):
-        return model._layer_fn(lp, h, None, None, layer_type=tag)
+    def layer(lp, h, tag=None, win=None):
+        return model._layer_fn(lp, h, None, None, window=win, layer_type=tag)
 
     def loss(other, h, batch_mb):
         return model.head_loss(other, h, batch_mb["labels"],
@@ -195,6 +190,16 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
         n_moe = sum(1 for i in range(model.cfg.num_layers)
                     if model.cfg.layer_type(i) == "moe") or 1
         aux_coef = float(model.cfg.moe_aux_loss_coef) / n_moe
+
+    # per-layer local/global windows ride a (stage, slot) table like the
+    # heterogeneous type dispatch (uniform sliding_window needs none:
+    # apply_attention defaults it from cfg)
+    win_tab = None
+    if hasattr(model, "_layer_windows"):
+        w = model._layer_windows()
+        if w is not None:
+            import numpy as _np
+            win_tab = _np.asarray(w, _np.int32).reshape(num_stages, -1)
 
     # ---- heterogeneous stacks: per-stage slot tables -------------------
     # Stages stay contiguous slices of the ORIGINAL layer order (reference
@@ -275,12 +280,17 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
                     lambda xx: xx, x)
 
                 aux0 = jnp.zeros((), jnp.float32)
+                wtab = (None if win_tab is None else
+                        jax.lax.dynamic_index_in_dim(
+                            jnp.asarray(win_tab), stage, 0, keepdims=False))
                 if het is None:
-                    def one(carry, lp):
+                    def one(carry, xs):
                         hh, aux = carry
-                        hh, a = layer_fn(lp, hh, None)
+                        lp, win = xs if win_tab is not None else (xs, None)
+                        hh, a = layer_fn(lp, hh, None, win)
                         return (hh, aux + a), None
-                    (h, aux_sum), _ = jax.lax.scan(one, (h, aux0), layers_p)
+                    xs = (layers_p, wtab) if win_tab is not None else layers_p
+                    (h, aux_sum), _ = jax.lax.scan(one, (h, aux0), xs)
                 else:
                     # slot walk: switch on this stage's (type, local index)
                     # tables — only the selected group's layer executes
@@ -288,15 +298,18 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
                         jnp.asarray(type_tab), stage, 0, keepdims=False)
                     stab = jax.lax.dynamic_index_in_dim(
                         jnp.asarray(slot_tab), stage, 0, keepdims=False)
+                    if wtab is None:
+                        wtab = jnp.zeros_like(ttab)   # <=0 = global sentinel
 
                     def branch(gi, tag):
                         def b(args):
-                            hh, ix = args
+                            hh, ix, win = args
                             lp = jax.tree.map(
                                 lambda a: jax.lax.dynamic_index_in_dim(
                                     a, ix, 0, keepdims=False),
                                 layers_p[f"g{gi}"])
-                            return layer_fn(lp, hh, tag)
+                            return layer_fn(lp, hh, tag,
+                                            win if win_tab is not None else None)
                         return b
 
                     branches = [branch(gi, tag)
@@ -304,10 +317,11 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
 
                     def one(carry, tt):
                         hh, aux = carry
-                        ty, ix = tt
-                        hh, a = jax.lax.switch(ty, branches, (hh, ix))
+                        ty, ix, win = tt
+                        hh, a = jax.lax.switch(ty, branches, (hh, ix, win))
                         return (hh, aux + a), None
-                    (h, aux_sum), _ = jax.lax.scan(one, (h, aux0), (ttab, stab))
+                    (h, aux_sum), _ = jax.lax.scan(one, (h, aux0),
+                                                   (ttab, stab, wtab))
                 lss = jax.lax.cond(
                     is_last,
                     lambda hh: loss_fn(other_pp, hh, bmb).astype(jnp.float32),
@@ -478,6 +492,11 @@ def build_pipeline_loss(model, num_stages: int):
             "heterogeneous layer stacks pipeline through the 1F1B engine "
             "(pipeline.schedule='1f1b', the default), not the GPipe "
             "autodiff path")
+    if (cfg.sliding_window is not None and cfg.local_attention_every) \
+            or cfg.window_pattern:
+        raise NotImplementedError(
+            "per-layer local/global window patterns pipeline through the "
+            "1F1B engine, not the GPipe autodiff path")
     assert cfg.num_layers % num_stages == 0, \
         f"num_layers={cfg.num_layers} not divisible by pipe={num_stages}"
     layers_per_stage = cfg.num_layers // num_stages

@@ -32,6 +32,7 @@ state exceeds HBM (the ZeRO-Infinity headline capability) train on a single
 chip.
 """
 
+import functools
 import math
 import os
 import queue
@@ -198,23 +199,11 @@ class InfinityRunner:
         from ...models.transformer import CausalLM
         if not isinstance(model, CausalLM):
             raise NotImplementedError("ZeRO-Infinity streaming requires a native CausalLM")
-        if model.cfg.is_moe:
-            raise NotImplementedError("ZeRO-Infinity streaming does not support MoE yet")
         if model.cfg.post_norm or model.cfg.mlm_head or not model.cfg.causal:
             raise NotImplementedError(
                 "ZeRO-Infinity streaming supports causal pre-norm decoders "
                 "only (its persistent head fabricates final_norm and uses "
                 "the causal head_loss)")
-        if (model.cfg.sliding_window is not None and
-                model.cfg.local_attention_every) or model.cfg.window_pattern:
-            raise NotImplementedError(
-                "per-layer local/global window patterns are not threaded "
-                "through the Infinity layer-group scan; uniform "
-                "sliding_window is supported")
-        if model._groups is not None:
-            raise NotImplementedError(
-                "heterogeneous layer stacks (cfg.layer_types) are not "
-                "supported by the Infinity layer-group streamer yet")
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
@@ -223,6 +212,30 @@ class InfinityRunner:
         if L % self.group_layers != 0:
             raise ValueError(f"num_layers {L} not divisible by group size {self.group_layers}")
         self.n_groups = L // self.group_layers
+        # heterogeneous stacks stream in original layer order; each
+        # streaming group must be type-homogeneous so its layers stack
+        # under one treedef (group_layers=1 admits ANY cfg.layer_types)
+        self._group_tags = []
+        for gi in range(self.n_groups):
+            tags = {self.cfg.layer_type(i)
+                    for i in range(gi * self.group_layers,
+                                   (gi + 1) * self.group_layers)}
+            if len(tags) > 1:
+                raise ValueError(
+                    f"streaming group {gi} mixes layer types {sorted(tags)}; "
+                    "set stream_group_layers so groups align with "
+                    "cfg.layer_types runs (stream_group_layers=1 always "
+                    "works)")
+            self._group_tags.append(tags.pop())
+        self._n_moe = sum(1 for i in range(L)
+                          if self.cfg.layer_type(i) == "moe") or 1
+        # per-layer local/global window patterns ride the group scan as xs
+        self._windows_host = None
+        if self.cfg.window_pattern is not None or (
+                self.cfg.sliding_window is not None
+                and self.cfg.local_attention_every):
+            w = model._layer_windows()
+            self._windows_host = np.asarray(w, np.int32)
         self.adam = _HostAdam(optimizer_hyper)
         self.gradient_clipping = float(gradient_clipping or 0.0)
         self.store = _GroupStore(nvme_path, buffer_count)
@@ -255,14 +268,19 @@ class InfinityRunner:
         self._persist_treedef = jax.tree.flatten(self.persist["p"])[1]
 
         layer_rngs = jax.random.split(r_layers, cfg.num_layers)
-        init_layer = jax.jit(lambda r: self.model._init_layer(r)[0])
-        self._layer_treedef = None
+        init_by_tag = {}
+        self._group_treedefs = [None] * self.n_groups
         for gi in range(self.n_groups):
+            tag = self._group_tags[gi]
+            if tag not in init_by_tag:
+                init_by_tag[tag] = jax.jit(functools.partial(
+                    lambda r, t: self.model._init_layer(r, layer_type=t)[0],
+                    t=tag))
             per = []
             for li in range(gi * self.group_layers, (gi + 1) * self.group_layers):
-                lp = init_layer(layer_rngs[li])
+                lp = init_by_tag[tag](layer_rngs[li])
                 leaves, td = jax.tree.flatten(lp)
-                self._layer_treedef = td
+                self._group_treedefs[gi] = td
                 per.append([np.asarray(x, np.float32) for x in leaves])
             stacked = [np.stack([row[j] for row in per]) for j in range(len(per[0]))]
             self.store.put(gi, {"p": stacked,
@@ -275,28 +293,42 @@ class InfinityRunner:
     def _compile_fns(self):
         model = self.model
         act = self.cfg.act_dtype
+        has_win = self._windows_host is not None
 
         def embed_fwd(emb, ids):
             return model.embed_fwd(emb, ids)
 
-        def fwd_group(gp, h, positions):
-            def body(h, lp):
-                h2, _ = model._layer_fn(lp, h, positions, None)
-                return h2, None
-            h, _ = jax.lax.scan(body, h, gp)
-            return h
+        def make_fwd(tag):
+            def fwd_group(gp, h, positions, wins):
+                def body(carry, xs):
+                    h, aux = carry
+                    lp, win = xs if has_win else (xs, None)
+                    h2, a = model._layer_fn(lp, h, positions, None,
+                                            window=win, layer_type=tag)
+                    return (h2, aux + a), None
+                xs = (gp, wins) if has_win else gp
+                (h, aux), _ = jax.lax.scan(
+                    body, (h, jnp.zeros((), jnp.float32)), xs)
+                return h, aux
+            return fwd_group
 
-        def bwd_group(gp, h, positions, dh):
-            _, vjp = jax.vjp(lambda gp_, h_: fwd_group(gp_, h_, positions), gp, h)
-            dgp, dh_in = vjp(dh)
-            return dgp, dh_in
+        def make_bwd(tag):
+            fwd = make_fwd(tag)
+
+            def bwd_group(gp, h, positions, wins, dh, daux):
+                _, vjp = jax.vjp(
+                    lambda gp_, h_: fwd(gp_, h_, positions, wins), gp, h)
+                dgp, dh_in = vjp((dh, daux))
+                return dgp, dh_in
+            return bwd_group
 
         def head(head_params, h, labels):
             return model.head_loss(head_params, h, labels)
 
-        def head_bwd(head_params, h, labels):
+        def head_bwd(head_params, h, labels, seed):
+            # fp16: the loss scale enters through the cotangent seed
             (loss), vjp = jax.vjp(lambda hp, h_: head(hp, h_, labels), head_params, h)
-            dhp, dh = vjp(jnp.ones((), jnp.float32))
+            dhp, dh = vjp(seed.astype(jnp.float32))
             return loss, dhp, dh
 
         def embed_bwd(emb, ids, dh):
@@ -304,11 +336,19 @@ class InfinityRunner:
             return vjp(dh)[0]
 
         self._embed_fwd = jax.jit(embed_fwd)
-        self._fwd_group = jax.jit(fwd_group)
-        self._bwd_group = jax.jit(bwd_group)
+        self._fwd_by_tag = {t: jax.jit(make_fwd(t))
+                            for t in set(self._group_tags)}
+        self._bwd_by_tag = {t: jax.jit(make_bwd(t))
+                            for t in set(self._group_tags)}
         self._head_bwd = jax.jit(head_bwd)
         self._embed_bwd = jax.jit(embed_bwd)
         self._act = act
+
+    def _group_windows(self, gi):
+        if self._windows_host is None:
+            return None
+        lo = gi * self.group_layers
+        return jnp.asarray(self._windows_host[lo:lo + self.group_layers])
 
     # ---------------- device staging ----------------
 
@@ -321,7 +361,7 @@ class InfinityRunner:
         devs = [jax.device_put(a.astype(np.dtype(act), copy=False)
                                if np.dtype(act) != np.float32 else a)
                 for a in st["p"]]
-        self._dev_groups[gi] = jax.tree.unflatten(self._layer_treedef, devs)
+        self._dev_groups[gi] = jax.tree.unflatten(self._group_treedefs[gi], devs)
         self.max_dev_groups = max(self.max_dev_groups, len(self._dev_groups))
 
     def _drop_group(self, gi: int):
@@ -329,15 +369,13 @@ class InfinityRunner:
 
     # ---------------- the step ----------------
 
-    def train_batch(self, batch, lr: Optional[float] = None):
-        """One full fwd/bwd/update with layer streaming. batch: host dict
-        with input_ids/labels of shape (B, S)."""
-        self.step_num += 1
+    def _microbatch_grads(self, ids, labels, loss_scale):
+        """One fwd/bwd streaming sweep; returns (loss, ce+aux host loss
+        pieces, per-group HOST grads list, persist grads, gsq of this
+        microbatch's grads). The head cotangent is seeded with
+        ``loss_scale`` (fp16), so grads come out SCALED."""
         cfg = self.cfg
-        ids = jnp.asarray(batch["input_ids"], jnp.int32)
-        labels = jnp.asarray(batch["labels"], jnp.int32)
         positions = jnp.broadcast_to(jnp.arange(ids.shape[1]), ids.shape)
-
         emb_dev = jax.tree.map(
             lambda a: jax.device_put(a.astype(np.dtype(self._act), copy=False)
                                      if np.dtype(self._act) != np.float32 else a),
@@ -347,9 +385,13 @@ class InfinityRunner:
         self._upload_group(0)
         h = self._embed_fwd(emb_dev["embed"], ids)
         boundaries = [h]
+        aux_parts = []   # device scalars; a float() here would sync the
+        # host per group and kill the prefetch/compute overlap
         for gi in range(self.n_groups):
             self._upload_group(gi + 1)  # prefetch while gi computes
-            h = self._fwd_group(self._dev_groups[gi], h, positions)
+            h, aux = self._fwd_by_tag[self._group_tags[gi]](
+                self._dev_groups[gi], h, positions, self._group_windows(gi))
+            aux_parts.append(aux)
             boundaries.append(h)
             if gi < self.n_groups - 1:
                 # release device copy (backward re-uploads in reverse order);
@@ -358,55 +400,107 @@ class InfinityRunner:
             self.store.evict_to_budget(keep=[gi, gi + 1])
 
         # ---- head loss + its grads ----
-        loss, d_head, dh = self._head_bwd(emb_dev, boundaries[-1], labels)
+        seed = jnp.float32(loss_scale)
+        ce, d_head, dh = self._head_bwd(emb_dev, boundaries[-1], labels, seed)
+        # MoE router aux joins the loss (CausalLM.loss semantics); its
+        # gradient enters every group's backward as a constant aux seed
+        aux_coef = (cfg.moe_aux_loss_coef / self._n_moe) if cfg.is_moe else 0.0
+        daux = jnp.float32(loss_scale * aux_coef)
 
-        # ---- backward: reverse streaming ----
-        # With gradient clipping the global norm must be known before ANY
-        # update (reference CPUAdam offload has the same barrier,
-        # ``stage3.py`` unscale-and-clip before the host step): grads are
-        # staged to host during the reverse sweep and updates start after.
-        # Without clipping, each group's update launches as soon as its
-        # gradient lands (fully overlapped with the remaining backward).
-        clip = self.gradient_clipping
-        futures = []
-        deferred = []   # (gi, host grad pytree) when clipping
-        gsq_sum = 0.0
+        # ---- backward: reverse streaming, grads staged to host ----
+        group_grads = [None] * self.n_groups
+        gsq = 0.0
         for gi in reversed(range(self.n_groups)):
             self._upload_group(gi - 1)  # prefetch for the next iteration
-            dgp, dh = self._bwd_group(self._dev_groups[gi], boundaries[gi],
-                                      positions, dh)
+            dgp, dh = self._bwd_by_tag[self._group_tags[gi]](
+                self._dev_groups[gi], boundaries[gi], positions,
+                self._group_windows(gi), dh, daux)
             for x in jax.tree.leaves(dgp):
                 x.copy_to_host_async()
-            if clip > 0:
-                host = [np.asarray(x, np.float32) for x in jax.tree.leaves(dgp)]
-                gsq_sum += sum(float(np.vdot(a, a)) for a in host)
-                deferred.append((gi, host))
-            else:
-                futures.append(self._pool.submit(self._update_group, gi, dgp, lr))
+            host = [np.asarray(x, np.float32) for x in jax.tree.leaves(dgp)]
+            gsq += sum(float(np.vdot(a, a)) for a in host)
+            group_grads[gi] = host
             self._drop_group(gi)
 
-        # ---- embedding grads (+ tied head contribution arrives via d_head) ----
+        # ---- embedding grads (+ tied head contribution via d_head) ----
         d_emb = self._embed_bwd(emb_dev["embed"], ids, dh)
         d_persist = {"embed": d_emb, "final_norm": d_head["final_norm"]}
         d_persist = jax.tree.map(jnp.add, d_persist,
                                  {"embed": d_head["embed"],
                                   "final_norm": jax.tree.map(jnp.zeros_like, d_head["final_norm"])})
+        d_persist = [np.asarray(x, np.float32)
+                     for x in jax.tree.leaves(d_persist)]
+        gsq += sum(float(np.vdot(a, a)) for a in d_persist)
+        aux_total = float(sum(aux_parts)) if aux_coef else 0.0
+        loss = float(ce) + aux_coef * aux_total
+        return loss, group_grads, d_persist, gsq
 
-        scale = 1.0
+    def train_batch(self, batch, lr: Optional[float] = None, gas: int = 1,
+                    loss_scale: float = 1.0):
+        """Full fwd/bwd/update with layer streaming. batch: host dict with
+        input_ids/labels of shape (gas * micro, S) or (gas, micro, S).
+
+        ``gas`` > 1 accumulates host-side gradients over microbatches
+        before the single update. ``loss_scale`` (fp16) seeds the backward;
+        returns (mean loss, overflow) when a non-unit scale is in play —
+        on overflow (non-finite grad norm) every update is skipped, the
+        reference's skip-step semantics.
+        """
+        cfg = self.cfg
+        ids_all = np.asarray(batch["input_ids"])
+        labels_all = np.asarray(batch["labels"])
+        if ids_all.ndim == 2:
+            ids_all = ids_all.reshape(gas, -1, ids_all.shape[-1])
+            labels_all = labels_all.reshape(gas, -1, labels_all.shape[-1])
+
+        acc_groups = None
+        acc_persist = None
+        losses = []
+        gsq_total = 0.0
+        for mb in range(gas):
+            ids = jnp.asarray(ids_all[mb], jnp.int32)
+            labels = jnp.asarray(labels_all[mb], jnp.int32)
+            loss, group_grads, d_persist, gsq = self._microbatch_grads(
+                ids, labels, loss_scale)
+            losses.append(loss)
+            gsq_total += gsq   # upper-bounds the summed-grad norm; exact at gas=1
+            if acc_groups is None:
+                if gas == 1:
+                    acc_groups, acc_persist = group_grads, d_persist
+                else:   # writable copies: device fetches are read-only views
+                    acc_groups = [[np.array(a) for a in g] for g in group_grads]
+                    acc_persist = [np.array(a) for a in d_persist]
+            else:
+                for gi in range(self.n_groups):
+                    for a, g in zip(acc_groups[gi], group_grads[gi]):
+                        a += g
+                for a, g in zip(acc_persist, d_persist):
+                    a += g
+
+        overflow = not np.isfinite(gsq_total)
+        mean_loss = float(np.mean(losses))
+        if overflow:
+            return mean_loss, True
+
+        # unscale (loss scale x gas) and clip on the ACCUMULATED grads
+        divisor = loss_scale * gas
+        clip = self.gradient_clipping
+        scale = 1.0 / divisor
         if clip > 0:
-            d_persist_host = [np.asarray(x, np.float32)
-                              for x in jax.tree.leaves(d_persist)]
-            gsq_sum += sum(float(np.vdot(a, a)) for a in d_persist_host)
-            gnorm = math.sqrt(gsq_sum)
-            scale = min(1.0, clip / (gnorm + 1e-6))
-            for gi, host in deferred:
-                futures.append(self._pool.submit(self._update_group, gi, host,
-                                                 lr, scale))
-        self._update_persist(d_persist, lr, grad_scale=scale)
+            gsq_acc = sum(float(np.vdot(a, a)) for gi in range(self.n_groups)
+                          for a in acc_groups[gi])
+            gsq_acc += sum(float(np.vdot(a, a)) for a in acc_persist)
+            gnorm = math.sqrt(gsq_acc) / divisor
+            scale *= min(1.0, clip / (gnorm + 1e-6))
 
+        self.step_num += 1
+        futures = [self._pool.submit(self._update_group, gi, acc_groups[gi],
+                                     lr, scale)
+                   for gi in range(self.n_groups)]
+        self._update_persist(acc_persist, lr, grad_scale=scale)
         for f in futures:
             f.result()  # surface worker exceptions; join before next step
-        return loss
+        return mean_loss, False
 
     # ---------------- host-side updates ----------------
 
@@ -417,7 +511,7 @@ class InfinityRunner:
             for p, m, v, g in zip(st["p"], st["m"], st["v"], g_leaves):
                 gh = np.ascontiguousarray(np.asarray(g), dtype=np.float32)
                 if grad_scale != 1.0:
-                    gh *= grad_scale
+                    gh = gh * grad_scale   # also: device views are read-only
                 self.adam.step(p, gh, m, v, self.step_num, lr)
         finally:
             self.store.unpin(gi)
@@ -431,7 +525,7 @@ class InfinityRunner:
         for p, m, v, g in zip(flat_p, flat_m, flat_v, flat_g):
             gh = np.ascontiguousarray(np.asarray(g), dtype=np.float32)
             if grad_scale != 1.0:
-                gh *= grad_scale
+                gh = gh * grad_scale   # also: device views are read-only
             self.adam.step(p, gh, m, v, self.step_num, lr)
 
     # ---------------- checkpoint ----------------
@@ -454,13 +548,28 @@ class InfinityRunner:
             self.store.evict_to_budget(keep=[int(gi_str)])
 
     def gathered_params(self):
-        """Full (host) fp32 param tree — the zero_to_fp32 analog."""
-        layers = []
+        """Full (host) fp32 param tree — the zero_to_fp32 analog. The layer
+        tree follows the model's layout: one stacked tree when homogeneous,
+        the grouped {"g0", ...} layout for heterogeneous stacks."""
+        per_layer = {}   # global layer index -> (treedef, leaf rows)
         for gi in range(self.n_groups):
             st = self.store.fetch(gi)
-            layers.append(st["p"])
+            for row in range(self.group_layers):
+                per_layer[gi * self.group_layers + row] = (
+                    self._group_treedefs[gi], [a[row] for a in st["p"]])
             self.store.evict_to_budget(keep=[gi])
-        stacked = [np.concatenate([g[j] for g in layers]) for j in range(len(layers[0]))]
+
+        def stack(idxs):
+            td = per_layer[idxs[0]][0]
+            leaves = [np.stack([per_layer[i][1][j] for i in idxs])
+                      for j in range(len(per_layer[idxs[0]][1]))]
+            return jax.tree.unflatten(td, leaves)
+
+        if self.model._groups is None:
+            layers = stack(list(range(self.cfg.num_layers)))
+        else:
+            layers = {f"g{k}": stack(list(idxs))
+                      for k, (_, idxs) in enumerate(self.model._groups)}
         return {"embed": self.persist["p"]["embed"],
-                "layers": jax.tree.unflatten(self._layer_treedef, stacked),
+                "layers": layers,
                 "final_norm": self.persist["p"]["final_norm"]}

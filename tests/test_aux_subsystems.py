@@ -645,3 +645,29 @@ def test_distilled_model_trains_under_engine():
     batch = provider({"input_ids": ids, "labels": ids})
     losses = [float(engine.train_batch(batch)) for _ in range(3)]
     assert losses[-1] < losses[0]
+
+
+def test_distilled_model_gets_engine_dtype_override():
+    """Engine precision overrides must reach the WRAPPED student (setting
+    cfg on the wrapper would shadow-attribute and silently change nothing)."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.compression.distillation import DistilledModel
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.utils import groups
+    groups.reset_mesh()
+    student = DistilledModel(build_model("tiny"), alpha=0.5)
+    engine, _, _, _ = ds.initialize(model=student, config={
+        "train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True}, "steps_per_print": 10 ** 9})
+    assert student.student.cfg.dtype == "bfloat16"
+    assert "cfg" not in vars(student)   # no shadow attribute on the wrapper
+
+
+def test_op_builder_prebuild_all():
+    """AOT prebuild path (reference DS_BUILD_OPS analog): every registered
+    op builds or reports a reasoned skip; nothing raises."""
+    from deepspeed_tpu.ops.op_builder import ALL_OPS, build_all
+    results = build_all(verbose=False)
+    assert set(results) == {cls().name for cls in ALL_OPS.values()}
+    assert all(s.startswith(("ok", "skipped")) for s in results.values()), results

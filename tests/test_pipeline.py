@@ -233,3 +233,22 @@ def test_1f1b_heterogeneous_stack(layer_types):
     ids = jnp.asarray(rng.integers(0, 256, (4, 2, 16)))
     _pipe_1f1b_vs_ref(model, params, {"input_ids": ids, "labels": ids}, 2,
                       rtol=2e-2, atol=2e-4)
+
+
+def test_1f1b_per_layer_window_pattern():
+    """Per-layer local/global window patterns (Gemma-2 style) pipeline
+    through 1F1B via the (stage, slot) window table: grads match plain
+    autodiff."""
+    from deepspeed_tpu.models.config import TransformerConfig
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(pipe=2, data=4))
+    cfg = TransformerConfig(
+        vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+        intermediate_size=128, max_seq_len=128,
+        window_pattern=(8, 0, 8, 0), dtype="float32", param_dtype="float32")
+    model = build_model(cfg)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, 256, (4, 2, 16)))
+    _pipe_1f1b_vs_ref(model, params, {"input_ids": ids, "labels": ids}, 2,
+                      rtol=2e-2, atol=2e-4)

@@ -380,18 +380,28 @@ def test_bert_chunked_loss_matches_dense():
 
 
 def test_pipeline_rejects_encoder_models():
-    """The compiled pipeline must loudly reject post-norm/MLM encoders and
-    per-layer local-attention patterns instead of training wrong numerics."""
+    """The compiled pipeline must loudly reject post-norm/MLM encoders
+    instead of training wrong numerics. Per-layer local-attention patterns
+    are 1F1B-supported since round 4 (window slot tables) but the GPipe
+    autodiff path still rejects them."""
     from deepspeed_tpu.models import build_model
-    from deepspeed_tpu.runtime.pipe.engine import check_pipeline_model_support
+    from deepspeed_tpu.runtime.pipe.engine import (build_pipeline_loss,
+                                                   check_pipeline_model_support)
+    from deepspeed_tpu.utils import groups
     bert = build_model("bert-base", num_layers=2, hidden_size=32, num_heads=4,
                        intermediate_size=64, vocab_size=128)
     with pytest.raises(NotImplementedError):
         check_pipeline_model_support(bert.cfg)
     from deepspeed_tpu.models.config import TransformerConfig
     neo_like = TransformerConfig(sliding_window=8, local_attention_every=2)
+    check_pipeline_model_support(neo_like)   # 1F1B handles this now
+    groups.reset_mesh()
+    groups.set_mesh(groups.build_mesh(pipe=2, data=4))
+    neo_model = build_model(neo_like.replace(
+        vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, dtype="float32"))
     with pytest.raises(NotImplementedError):
-        check_pipeline_model_support(neo_like)
+        build_pipeline_loss(neo_model, num_stages=2)
 
 
 def test_container_gemma_geglu_scaled_embed():
