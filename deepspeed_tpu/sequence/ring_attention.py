@@ -12,6 +12,13 @@ GLOBAL positions per ring step, so sliding windows, ALiBi slopes, and
 packed-sequence segment ids (which rotate around the ring with their KV
 shard) all compose with the causal ring — long-context packed pretraining
 can choose ring vs Ulysses on merit rather than on feature support.
+
+Memory (round-4): each ring step computes its scores in 512-query chunks
+(flash-style, expressed as a ``lax.scan`` XLA fuses per chunk), so the peak
+fp32 intermediate is (B, H, 512, S/n) rather than (B, H, S/n, S/n), and
+GQA contracts grouped einsums against the raw KV heads — K/V are never
+``repeat``-materialized. At 64k tokens on 8 ranks that is ~16x less
+attention scratch per step than the round-3 form.
 """
 
 import functools
@@ -24,30 +31,68 @@ from ..utils import groups
 
 NEG_INF = -1e30
 
+_RING_CACHE = {}
 
-def _block_attend(q, k, v, scale, mask, bias=None):
-    """Partial (unnormalized) attention of local q against one kv block.
 
-    mask: (B|1, 1, Sq, Sk) bool visibility; bias: optional additive
-    (1, H, Sq, Sk) term (ALiBi). Returns (m, l, o_partial).
-    q: (B, Sq, H, D); k/v: (B, Sk, KVH, D).
+def _block_attend(q, k, v, scale, q_pos, k_pos, window, seg_q, seg_k,
+                  slopes, chunk=512):
+    """Partial (unnormalized) attention of local q against one kv block,
+    computed in QUERY CHUNKS: the (B, H, Cq, Sk) fp32 scores are the peak
+    intermediate, not (B, H, Sq, Sk) — at real long-context shard sizes the
+    full block would bound memory (round-3 review). GQA contracts against
+    the raw (B, Sk, KVH, D) K/V via a grouped einsum — kv heads are never
+    repeated.
+
+    Returns (m, l, o_partial): (B, H, Sq), (B, H, Sq), (B, Sq, H, D) fp32.
     """
     b, sq, h, d = q.shape
+    sk = k.shape[1]
     kvh = k.shape[2]
-    if kvh != h:
-        rep = h // kvh
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32) * scale
-    if bias is not None:
-        s = s + bias
-    s = jnp.where(mask, s, NEG_INF)
-    m = jnp.max(s, axis=-1)                                   # (B, H, Sq)
-    p = jnp.exp(s - m[..., None])
-    p = jnp.where(mask, p, 0.0)                               # kill exp(NEG_INF - NEG_INF)
-    l = jnp.sum(p, axis=-1)                                   # (B, H, Sq)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)   # (B, Sq, H, D)
-    return m, l, o.astype(jnp.float32)
+    g = h // kvh
+    cq = min(chunk, sq)
+    if sq % cq:
+        cq = sq   # odd shard sizes: one chunk (tests; real shards are 2^k)
+    nq = sq // cq
+    q5 = q.reshape(b, nq, cq, kvh, g, d).transpose(1, 0, 2, 3, 4, 5)
+    qpos_c = q_pos.reshape(nq, cq)
+    segq_c = (None if seg_q is None
+              else seg_q.reshape(b, nq, cq).transpose(1, 0, 2))
+
+    def one(_, xs):
+        if seg_q is not None:
+            qc, qp, sg = xs
+        else:
+            (qc, qp), sg = xs, None
+        # (B, Cq, KVH, G, D) x (B, Sk, KVH, D) -> (B, KVH, G, Cq, Sk)
+        s = jnp.einsum("bcngd,bknd->bngck", qc, k,
+                       preferred_element_type=jnp.float32) * scale
+        rel = qp[:, None] - k_pos[None, :]                    # (Cq, Sk)
+        if slopes is not None:
+            s = s + (slopes.reshape(kvh, g)[None, :, :, None, None]
+                     * (-rel).astype(jnp.float32)[None, None, None])
+        mask = rel >= 0                                       # causal
+        if window is not None:
+            from ..ops.attention import window_mask
+            mask = mask & window_mask(qp[:, None], k_pos[None, :], window)
+        mask = mask[None, None, None]                         # (1,1,1,Cq,Sk)
+        if sg is not None:
+            mask = mask & (sg[:, None, None, :, None]
+                           == seg_k[:, None, None, None, :])
+        s = jnp.where(mask, s, NEG_INF)
+        m = jnp.max(s, axis=-1)                               # (B, KVH, G, Cq)
+        p = jnp.exp(s - m[..., None])
+        p = jnp.where(mask, p, 0.0)       # kill exp(NEG_INF - NEG_INF)
+        l = jnp.sum(p, axis=-1)
+        o = jnp.einsum("bngck,bknd->bcngd", p.astype(v.dtype), v)
+        return None, (m, l, o.astype(jnp.float32))
+
+    xs = (q5, qpos_c, segq_c) if seg_q is not None else (q5, qpos_c)
+    _, (m, l, o) = jax.lax.scan(one, None, xs)
+    # (nq, B, KVH, G, Cq) -> (B, H, Sq);  (nq, B, Cq, KVH, G, D) -> (B, Sq, H, D)
+    m = m.transpose(1, 2, 3, 0, 4).reshape(b, h, sq)
+    l = l.transpose(1, 2, 3, 0, 4).reshape(b, h, sq)
+    o = o.transpose(1, 0, 2, 3, 4, 5).reshape(b, sq, h, d)
+    return m, l, o
 
 
 def _ring_body(q, k, v, seg, axis_name, scale, window, slopes, vary_axes=None):
@@ -64,18 +109,8 @@ def _ring_body(q, k, v, seg, axis_name, scale, window, slopes, vary_axes=None):
         k_blk, v_blk, kseg_blk = kv
         src = (p_idx - i) % n        # rank that produced this kv block
         k_pos = src * sk + jnp.arange(sk)
-        rel = q_pos[:, None] - k_pos[None, :]                 # (Sq, Sk)
-        mask2 = rel >= 0                                      # causal
-        if window is not None:
-            from ..ops.attention import window_mask
-            mask2 = mask2 & window_mask(q_pos[:, None], k_pos[None, :], window)
-        mask = mask2[None, None]                              # (1,1,Sq,Sk)
-        if kseg_blk is not None:
-            mask = mask & (seg[:, None, :, None] == kseg_blk[:, None, None, :])
-        bias = None
-        if slopes is not None:
-            bias = (slopes[:, None, None] * (-rel).astype(jnp.float32))[None]
-        m_b, l_b, o_b = _block_attend(q, k_blk, v_blk, scale, mask, bias)
+        m_b, l_b, o_b = _block_attend(q, k_blk, v_blk, scale, q_pos, k_pos,
+                                      window, seg, kseg_blk, slopes)
         m_new = jnp.maximum(m_acc, m_b)
         a_old = jnp.exp(m_acc - m_new)
         a_new = jnp.exp(m_b - m_new)
@@ -128,14 +163,39 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None,
 
     vary_axes = (axis_name,) + (batch_axes or ())
     has_seg = segment_ids is not None
-    body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
-                             window=window, slopes=slopes,
-                             vary_axes=vary_axes)
-    fn = jax.shard_map(
-        body if has_seg else functools.partial(body, seg=None),
-        mesh=mesh,
-        in_specs=(spec, spec, spec) + ((seg_spec,) if has_seg else ()),
-        out_specs=spec,
-        axis_names={axis_name} | (set(batch_axes) if batch_axes else set()),
-        check_vma=True)
+
+    def build():
+        body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
+                                 window=window, slopes=slopes,
+                                 vary_axes=vary_axes)
+        fn = jax.shard_map(
+            body if has_seg else functools.partial(body, seg=None),
+            mesh=mesh,
+            in_specs=(spec, spec, spec) + ((seg_spec,) if has_seg else ()),
+            out_specs=spec,
+            axis_names={axis_name} | (set(batch_axes) if batch_axes else set()),
+            check_vma=True)
+        # jit: the chunked scan inside the manual region cannot evaluate
+        # eagerly (free when this call is itself inside an outer jit)
+        return jax.jit(fn)
+
+    # cache the jitted ring per static config: jax.jit keys on the callable
+    # object, and rebuilding it per call would recompile every EAGER
+    # invocation. Unhashable statics (traced window — only possible under
+    # an outer jit, where nesting makes the rebuild free) skip the cache.
+    try:
+        key = (mesh, axis_name, float(scale),
+               window if isinstance(window, (int, type(None))) else None,
+               None if alibi_slopes is None
+               else tuple(float(x) for x in jnp.asarray(alibi_slopes)),
+               has_seg)
+        hashable = isinstance(window, (int, type(None)))
+    except Exception:
+        hashable = False
+    if hashable:
+        fn = _RING_CACHE.get(key)
+        if fn is None:
+            fn = _RING_CACHE[key] = build()
+    else:
+        fn = build()
     return fn(q, k, v, *((segment_ids,) if has_seg else ()))
