@@ -44,6 +44,14 @@ def _unflatten_from_paths(flat: Dict[str, Any]):
     return root
 
 
+def jnp_asarray_like(ref, x):
+    """Stage a host array with the dtype of an existing leaf, UNCOMMITTED
+    (like the fresh tree it replaces) — a committed device_put would
+    conflict with mesh-sharded co-arguments at the next jit call."""
+    import jax.numpy as jnp
+    return jnp.asarray(np.asarray(x, dtype=ref.dtype))
+
+
 def _fetch_replicated(engine, tree):
     """Consolidate a (possibly ZeRO-sharded, possibly multi-process) state
     tree to host numpy, leaf by leaf: each leaf is replicated through a
@@ -73,6 +81,11 @@ def ds_to_universal(engine, output_dir: str):
         "module": engine.module_state_dict(),
         "optimizer": _fetch_replicated(engine, opt_tree),
     }
+    if getattr(engine, "_twinflow", None) is not None:
+        # Twin-Flow keeps the device half of the optimizer state outside
+        # _host_optimizer; without it a resume would run the device update
+        # from freshly-initialized masters/moments.
+        state["twinflow"] = _fetch_replicated(engine, engine._twinflow["dev_state"])
     if jax.process_index() != 0:
         return None
     index = {"params": [], "meta": {
@@ -81,9 +94,15 @@ def ds_to_universal(engine, output_dir: str):
         "micro_steps": engine.micro_steps,
         "zero_stage": engine.zero_stage,
     }}
-    for section in ("module", "optimizer"):
+    for section in state:
         flat = _flatten_with_paths(state[section])
         for path, arr in flat.items():
+            if arr is None:
+                # masked leaves (Twin-Flow host/device split) — keep the
+                # tree position in the index, no payload
+                index["params"].append({"section": section, "path": path,
+                                        "none": True})
+                continue
             arr = np.asarray(arr)
             fname = f"{section}.{path}.npy".replace("/", "_")
             np.save(os.path.join(output_dir, fname), arr)
@@ -100,18 +119,49 @@ def load_universal_checkpoint(engine, load_dir: str, load_optimizer_states: bool
     (reference load_universal_checkpoint → universal_checkpoint.py:22)."""
     with open(os.path.join(load_dir, INDEX_FILE)) as f:
         index = json.load(f)
-    sections: Dict[str, Dict[str, np.ndarray]] = {"module": {}, "optimizer": {}}
+    sections: Dict[str, Dict[str, Optional[np.ndarray]]] = {
+        "module": {}, "optimizer": {}}
     for entry in index["params"]:
-        arr = np.load(os.path.join(load_dir, entry["file"]))
-        sections[entry["section"]][entry["path"]] = arr
+        arr = (None if entry.get("none")
+               else np.load(os.path.join(load_dir, entry["file"])))
+        sections.setdefault(entry["section"], {})[entry["path"]] = arr
     module = _unflatten_from_paths(sections["module"])
     engine.module_params = jax.device_put(module, engine.param_shardings)
     if load_optimizer_states and sections["optimizer"]:
         opt = _unflatten_from_paths(sections["optimizer"])
-        opt = jax.tree.map(lambda x, ref: np.asarray(x, dtype=ref.dtype),
-                           opt, jax.tree.map(lambda s: s, jax.eval_shape(
-                               engine.optimizer.init, engine.model.abstract_params())))
-        engine.opt_state = jax.device_put(opt, engine.opt_state_shardings)
+        if getattr(engine, "_host_optimizer", None) is not None:
+            # ZeRO-Offload(native): the saved tree IS the host optimizer's
+            # state_dict ({"step", "slots"}). Route it into the host
+            # masters/moments — assigning engine.opt_state (None and unused
+            # in this mode) would leave the first train_batch to overwrite
+            # the restored module params with stale init-time masters
+            # (advisor r4, universal.py:114).
+            dev = None
+            if getattr(engine, "_twinflow", None) is not None:
+                if "twinflow" not in sections:
+                    # a silent skip would leave init-time device masters and
+                    # revert the device-half weights on the next step (same
+                    # bug class the host side now raises for)
+                    raise ValueError(
+                        "universal checkpoint has no 'twinflow' section but "
+                        "this engine runs Twin-Flow (offload ratio < 1) — "
+                        "the checkpoint was saved under a different "
+                        "host/device split; resume with the saving config "
+                        "or re-snapshot")
+                dev = jax.tree.map(
+                    jnp_asarray_like, engine._twinflow["dev_state"],
+                    _unflatten_from_paths(sections["twinflow"]))
+            engine._restore_host_optimizer_state(opt, dev)
+        else:
+            opt = jax.tree.map(lambda x, ref: np.asarray(x, dtype=ref.dtype),
+                               opt, jax.tree.map(lambda s: s, jax.eval_shape(
+                                   engine.optimizer.init, engine.model.abstract_params())))
+            engine.opt_state = jax.device_put(opt, engine.opt_state_shardings)
+    else:
+        # optimizer state skipped (by flag, or absent from the checkpoint):
+        # masters and device master-slots must track the freshly restored
+        # weights, or the first update reverts them to init-time values
+        engine._resync_masters_from_params()
     meta = index.get("meta", {})
     engine.global_steps = int(meta.get("global_steps", 0))
     engine.global_samples = int(meta.get("global_samples", 0))

@@ -25,6 +25,7 @@ contract). Anything smarter (coordination-service queries) couples recovery
 to the very service that just lost a member.
 """
 
+import json
 import os
 import time
 from typing import Callable, List, Optional
@@ -49,6 +50,7 @@ class InProcessElasticWorker:
         self.heartbeat_timeout = float(heartbeat_timeout)
         self.rank: Optional[int] = None
         self.world: Optional[int] = None
+        self._epoch = 0
         os.makedirs(run_dir, exist_ok=True)
 
     # ---- liveness ----------------------------------------------------
@@ -68,6 +70,15 @@ class InProcessElasticWorker:
     def start(self, rank: int, world: int):
         self.rank = int(rank)
         self.world = int(world)
+        if self.rank == 0:
+            # leftover membership files from a previous incarnation of this
+            # run_dir would be adopted as the current alive set; nobody reads
+            # them until a failure, so cleaning at bring-up is race-free
+            for epoch, path in self._membership_files().items():
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
         self.heartbeat()
 
     def heartbeat(self):
@@ -89,6 +100,75 @@ class InProcessElasticWorker:
     def membership_changed(self) -> bool:
         return len(self.alive_ranks()) < self.world
 
+    def _membership_files(self):
+        out = {}
+        try:
+            names = os.listdir(self.run_dir)
+        except OSError:
+            return out
+        for fn in names:
+            if fn.startswith("membership.") and not fn.count(".tmp"):
+                try:
+                    out[int(fn.split(".", 1)[1])] = os.path.join(self.run_dir, fn)
+                except ValueError:
+                    pass
+        return out
+
+    def _agree_alive(self) -> List[int]:
+        """Survivors must agree on ONE alive set before re-initializing: a
+        heartbeat mtime that straddles the timeout at the moment each
+        survivor looks would otherwise yield different
+        (num_processes, process_id) arguments and hang/abort the rebuilt
+        world (advisor r4). Every survivor waits a settle delay (lets
+        straddling mtimes resolve), re-reads, and the one that then believes
+        itself lowest-alive publishes its set to the next epoch's file with
+        O_EXCL — FIRST writer wins, so even if two survivors self-elect
+        (they disagreed about each other's liveness), everyone re-reads the
+        single published file and adopts the same set. The epoch is
+        discovered by scanning, not counted blindly, so a survivor that
+        coalesced two failures into one rejoin stays in sync."""
+        baseline = self._epoch
+        time.sleep(min(1.0, self.heartbeat_timeout / 4))
+        self.heartbeat()
+        alive = self.alive_ranks()
+
+        def newest_published():
+            # any epoch past our last consumed one counts — a survivor that
+            # detected the failure late must adopt the set the leader has
+            # ALREADY published, not wait on a self-computed future epoch
+            files = {e: p for e, p in self._membership_files().items()
+                     if e > baseline}
+            for e in sorted(files, reverse=True):
+                try:
+                    with open(files[e]) as f:
+                        return e, json.loads(f.read())
+                except (OSError, ValueError):
+                    continue    # mid-write; a lower epoch or retry covers it
+            return None, None
+
+        epoch, published = newest_published()
+        if published is None and alive and self.rank == min(alive):
+            epoch = max(self._membership_files().keys() | {baseline}) + 1
+            path = os.path.join(self.run_dir, f"membership.{epoch}")
+            try:
+                fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_EXCL)
+                with os.fdopen(fd, "w") as f:
+                    f.write(json.dumps(alive))
+            except FileExistsError:
+                pass      # another self-elected survivor won the publish
+        deadline = time.time() + 2 * self.heartbeat_timeout
+        while time.time() < deadline:
+            epoch, published = newest_published()
+            if published is not None:
+                self._epoch = epoch
+                return published
+            time.sleep(0.1)
+        # leader died between detection and publish: fall back to own view
+        logger.warning("[elastic-rejoin] no membership published after epoch "
+                       f"{baseline}; using local view {alive}")
+        self._epoch = baseline + 1
+        return alive
+
     # ---- checkpoint --------------------------------------------------
 
     def save_universal(self, engine):
@@ -109,7 +189,7 @@ class InProcessElasticWorker:
         # (blocked in a long step) must not drop out of its own alive set —
         # that would collapse new_rank to 0 on several survivors at once
         self.heartbeat()
-        alive = self.alive_ranks()
+        alive = self._agree_alive()
         new_world = max(1, len(alive))
         logger.warning(
             f"[elastic-rejoin] membership change: {self.world} -> {new_world} "

@@ -116,19 +116,31 @@ class NativeOpBuilder(OpBuilder):
             return False
         return True
 
+    def _resolved_sources(self):
+        # sources() are repo-relative: resolve against the package root, not
+        # the process CWD (engines are routinely built from other dirs)
+        return [s if os.path.isabs(s) else os.path.join(_repo_root(), s)
+                for s in self.sources()]
+
     def _needs_rebuild(self):
         lib = self.lib_path()
         if not os.path.exists(lib):
             return True
         lib_mtime = os.path.getmtime(lib)
-        return any(os.path.getmtime(src) > lib_mtime for src in self.sources())
+        missing = [s for s in self._resolved_sources() if not os.path.exists(s)]
+        if missing:
+            raise FileNotFoundError(
+                f"op '{self.name}': source file(s) {missing} not found — "
+                "refusing to load a stale library built from removed sources")
+        return any(os.path.getmtime(src) > lib_mtime
+                   for src in self._resolved_sources())
 
     def jit_load(self, verbose=True):
         import ctypes
         if self._needs_rebuild():
             start = time.time()
             os.makedirs(os.path.dirname(self.lib_path()), exist_ok=True)
-            srcs = [os.path.join(_repo_root(), s) if not os.path.isabs(s) else s for s in self.sources()]
+            srcs = self._resolved_sources()
             incs = [f"-I{os.path.join(_repo_root(), i) if not os.path.isabs(i) else i}" for i in self.include_paths()]
             cmd = ["g++", "-shared", *self.cxx_args(), *incs, *srcs, "-o", self.lib_path(), *self.extra_ldflags()]
             if verbose:

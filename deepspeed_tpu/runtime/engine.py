@@ -1111,6 +1111,28 @@ class DeepSpeedEngine:
         self.module_params = jax.device_put(state_dict, self.param_shardings)
         self._resync_masters_from_params()
 
+    def _restore_host_optimizer_state(self, opt_tree, twinflow_dev_tree=None):
+        """Route a saved optimizer tree ({"step", "slots"}) into the host
+        optimizer (+ the Twin-Flow device half), then derive module params
+        from the restored masters — every future host update starts from the
+        masters, so module params must track them. Shared by load_checkpoint
+        and the universal-checkpoint restore (elastic rejoin)."""
+        self._host_optimizer.load_state_dict(opt_tree)
+        if self._twinflow is not None:
+            if twinflow_dev_tree is not None:
+                self._twinflow["dev_state"] = twinflow_dev_tree
+            # host masters overwrite only the host-owned leaves; the device
+            # half came in with the module section
+            tdef, mask = self._twinflow["treedef"], self._twinflow["mask"]
+            flat_p = jax.tree.leaves(self.module_params)
+            host_half = self._to_param_layout(self._host_optimizer.params())
+            host_it = iter(jax.tree.leaves(host_half))
+            self.module_params = tdef.unflatten(
+                [next(host_it) if m else p for p, m in zip(flat_p, mask)])
+        else:
+            self.module_params = self._to_param_layout(
+                self._host_optimizer.params())
+
     def _resync_masters_from_params(self):
         """fp32 masters (host offload, Twin-Flow halves, device master
         slots) must track externally loaded module weights."""
@@ -1528,21 +1550,10 @@ class DeepSpeedEngine:
             return path, state["meta"].get("client_state", {})
         if load_optimizer_states:
             if self._host_optimizer is not None:
-                self._host_optimizer.load_state_dict(state["optimizer"])
-                if self._twinflow is not None:
-                    self._twinflow["dev_state"] = state["twinflow_device"]
-                    # host masters overwrite only the host-owned leaves; the
-                    # device half came in with state["module"]
-                    tdef, mask = self._twinflow["treedef"], self._twinflow["mask"]
-                    flat_p = jax.tree.leaves(self.module_params)
-                    host_half = self._to_param_layout(self._host_optimizer.params())
-                    host_it = iter(jax.tree.leaves(host_half))
-                    flat_new = [next(host_it) if m else p
-                                for p, m in zip(flat_p, mask)]
-                    self.module_params = tdef.unflatten(flat_new)
-                else:
-                    self.module_params = self._to_param_layout(
-                        self._host_optimizer.params())
+                self._restore_host_optimizer_state(
+                    state["optimizer"],
+                    state["twinflow_device"] if self._twinflow is not None
+                    else None)
             else:
                 self.opt_state = state["optimizer"]
         self.scaler_state = LossScaleState(**{

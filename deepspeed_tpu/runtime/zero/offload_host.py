@@ -332,8 +332,16 @@ class HostOffloadOptimizer:
         self._step = int(np.asarray(jax.device_get(sd["step"])))
         flat_slots = self._treedef.flatten_up_to(sd["slots"])
         for slot, lf in zip(flat_slots, self._leaves):
-            if lf is None or slot is None:
+            if lf is None:
                 continue
+            if slot is None:
+                # a silent skip here would leave init-time masters for this
+                # leaf and revert its weights on the next step
+                raise ValueError(
+                    "saved optimizer state has no host shard for a leaf of "
+                    f"shape {lf['shape']} that this engine hosts — the "
+                    "host/device split (Twin-Flow ratio/mask) differs "
+                    "between save and load")
             for f in ("master",) + self.kernel.fields:
                 arr = slot[f]
                 if isinstance(arr, jax.Array) and not arr.is_fully_addressable:

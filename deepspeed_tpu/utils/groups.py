@@ -114,9 +114,22 @@ def mesh_is_initialized() -> bool:
     return _MESH is not None
 
 
+_RESET_HOOKS = []
+
+
+def register_reset_hook(fn):
+    """Caches keyed on the live mesh (compiled rings etc.) register a
+    clearer here so reset_mesh() drops them with the mesh — long-lived
+    processes that rebuild meshes (elastic rejoin, test loops) must not
+    leak executables compiled for dead meshes (advisor r4)."""
+    _RESET_HOOKS.append(fn)
+
+
 def reset_mesh():
     global _MESH
     _MESH = None
+    for fn in _RESET_HOOKS:
+        fn()
 
 
 def _axis_size(name: str) -> int:

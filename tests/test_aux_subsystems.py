@@ -490,6 +490,8 @@ def test_elastic_in_process_rejoin(tmp_path):
         losses = [step(engine) for _ in range(3)]
         w.heartbeat()
         w.save_universal(engine)
+        snap = np.asarray(jax.tree.leaves(engine.module_params)[0],
+                          np.float32).copy()
         if rank == 1:
             os._exit(1)                      # hard death, no cleanup
 
@@ -503,9 +505,12 @@ def test_elastic_in_process_rejoin(tmp_path):
         assert os.getpid() == pid0            # same process, no restart
         assert jax.process_count() == 1
         assert engine.global_steps == 3       # resumed from the snapshot
+        restore_err = float(np.max(np.abs(np.asarray(
+            jax.tree.leaves(engine.module_params)[0], np.float32) - snap)))
         after = [step(engine) for _ in range(2)]
         assert all(np.isfinite(after))
         print("RESULT " + json.dumps({"losses": losses, "after": after,
+                                      "restore_err": restore_err,
                                       "world_end": len(jax.devices())}))
     """) % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -531,8 +536,12 @@ def test_elastic_in_process_rejoin(tmp_path):
 
     assert res["world_end"] == 2              # rank 0's two local devices
     assert len(res["after"]) == 2
-    # training continued sanely from the snapshot
-    assert res["after"][-1] < res["losses"][0]
+    # state restoration is the property under test: the rebuilt engine's
+    # params equal the pre-kill snapshot (the universal checkpoint was taken
+    # at the same step), and post-rejoin training stays finite — a strict
+    # loss-decrease over 2 random-batch steps would be stochastic
+    assert res["restore_err"] <= 1e-5
+    assert all(np.isfinite(res["after"]))
 
 
 def test_xtc_binarize_ternarize():
