@@ -22,6 +22,7 @@ in-kernel too) need no gathered bias tensors. Pages wholly outside
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,11 +33,15 @@ NEG_INF = -1e30
 
 
 def _paged_kernel(lyr_ref, bt_ref, cs_ref, lo_ref, win_ref,   # scalar prefetch
-                  q_ref, k_ref, v_ref, pos_ref, slope_ref,
-                  ck_ref, cv_ref, cpos_ref,       # current-chunk KV blocks
-                  o_ref,                          # output
-                  m_ref, l_ref, acc_ref,          # VMEM scratch
-                  *, page_size, pages_max, scale, softcap, use_alibi):
+                  q_ref, *rest,                   # K k-pages, K v-pages, ...
+                  page_size, grid_steps, pages_per_step, scale, softcap,
+                  use_alibi):
+    K = pages_per_step
+    k_refs = rest[0:K]
+    v_refs = rest[K:2 * K]
+    (pos_ref, slope_ref, ck_ref, cv_ref, cpos_ref,   # chunk KV blocks
+     o_ref,                                          # output
+     m_ref, l_ref, acc_ref) = rest[2 * K:]           # VMEM scratch
     b = pl.program_id(0)
     j = pl.program_id(2)
 
@@ -84,22 +89,29 @@ def _paged_kernel(lyr_ref, bt_ref, cs_ref, lo_ref, win_ref,   # scalar prefetch
     # chunk's own KV arrives as separate blocks below, NOT via the pool —
     # keeping the pool read-only inside the layer scan is what lets XLA
     # leave it in place (a scattered-then-read pool forces pool-sized
-    # defensive copies; measured pool-size-bound decode)
-    active = jnp.logical_and(j * page_size < cs_ref[b],
-                             (j + 1) * page_size > lo_ref[b])
+    # defensive copies; measured pool-size-bound decode).
+    # One grid step covers K pages fused into ONE (R, K*bs) score matmul —
+    # per-step overhead (DMA latency, semaphores) amortizes over K pages and
+    # the MXU tile is K× wider (one-page steps measurably lose to the XLA
+    # gather path on latency-floored parts; VERDICT r4).
+    active = jnp.logical_and(j * K * page_size < cs_ref[b],
+                             (j * K + K) * page_size > lo_ref[b])
 
     @pl.when(active)
-    def _page():
+    def _pages():
         q = q_ref[0, 0]                                   # (R, D) R = C*G
-        k = k_ref[0, 0, 0]                                # (bs, D)
-        v = v_ref[0, 0, 0]
-        slot = (j * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, (q.shape[0], page_size), 1)).astype(jnp.float32)
+        k = jnp.concatenate([r[0, 0, 0] for r in k_refs], axis=0)  # (K*bs, D)
+        v = jnp.concatenate([r[0, 0, 0] for r in v_refs], axis=0)
+        # logical slot of each fetched key: pages past the table's end are
+        # fetched clamped but their logical slots are >= MB*bs >= cs → the
+        # staleness mask kills them
+        slot = (j * K * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (q.shape[0], K * page_size), 1)).astype(jnp.float32)
         s, mask = scores(q, k, slot)
         mask = jnp.logical_and(mask, slot < cs_ref[b].astype(jnp.float32))
         online_update(s, mask, v)
 
-    @pl.when(j == pages_max - 1)
+    @pl.when(j == grid_steps - 1)
     def _chunk_and_finalize():
         q = q_ref[0, 0]
         ck = ck_ref[0, 0]                                 # (C, D)
@@ -115,7 +127,7 @@ def _paged_kernel(lyr_ref, bt_ref, cs_ref, lo_ref, win_ref,   # scalar prefetch
 def paged_ragged_attention(q, kpool, vpool, block_tables, positions,
                            chunk_k=None, chunk_v=None, *, layer=None,
                            scale=None, window=0, alibi_slopes=None,
-                           softcap=0.0):
+                           softcap=0.0, pages_per_step=None):
     """Unified paged attention for decode AND chunked prefill.
 
     q: (B, C, H, D) — C query tokens per sequence (1 = decode);
@@ -191,13 +203,28 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions,
     else:
         slopes = jnp.zeros((kvh, 1, rows), jnp.float32)
 
-    grid = (b, kvh, mb)
+    if pages_per_step is None:
+        pages_per_step = int(os.environ.get("DS_TPU_PAGES_PER_STEP", "8"))
+    K = max(1, min(int(pages_per_step), mb))
+    grid_steps = -(-mb // K)
+    grid = (b, kvh, grid_steps)
 
     def q_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
         return (bi, hi, 0, 0)
 
-    def kv_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
-        return (lyr_[0], hi, bt[bi, ji], 0, 0)
+    def kv_map_t(t):
+        # t-th page of this grid step's K-page group. The page lookup is
+        # clamped into the sequence's LIVE range [lo/bs, ceil(cs/bs)-1]:
+        # steps outside it all map to the same page, and Pallas elides the
+        # DMA when consecutive grid steps index an identical block — dead
+        # pages (beyond the sequence, or below the sliding window) cost no
+        # HBM traffic. Correctness is unaffected: the kernel masks by the
+        # LOGICAL slot (ji*K+t), not the fetched page.
+        def kv_map(bi, hi, ji, lyr_, bt, cs, lo_, w_):
+            last = jnp.maximum((cs[bi] + page_size - 1) // page_size - 1, 0)
+            jt = jnp.clip(ji * K + t, lo_[bi] // page_size, last)
+            return (lyr_[0], hi, bt[bi, jt], 0, 0)
+        return kv_map
 
     def pos_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
         return (bi, 0, 0)
@@ -208,8 +235,11 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions,
     def chunk_map(bi, hi, ji, lyr_, bt, lens, lo_, w_):
         return (bi, hi, 0, 0)
 
+    page_spec = [pl.BlockSpec((1, 1, 1, page_size, d), kv_map_t(t))
+                 for t in range(K)]
     out = pl.pallas_call(
-        functools.partial(_paged_kernel, page_size=page_size, pages_max=mb,
+        functools.partial(_paged_kernel, page_size=page_size,
+                          grid_steps=grid_steps, pages_per_step=K,
                           scale=scale, softcap=softcap,
                           use_alibi=use_alibi),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -217,8 +247,8 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, rows, d), q_map),
-                pl.BlockSpec((1, 1, 1, page_size, d), kv_map),
-                pl.BlockSpec((1, 1, 1, page_size, d), kv_map),
+                *page_spec,                                    # K k-pages
+                *page_spec,                                    # K v-pages
                 pl.BlockSpec((1, 1, rows), pos_map),
                 pl.BlockSpec((1, 1, rows), slope_map),
                 pl.BlockSpec((1, 1, c, d), chunk_map),
@@ -236,7 +266,8 @@ def paged_ragged_attention(q, kpool, vpool, block_tables, positions,
         interpret=jax.default_backend() != "tpu",
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary")),
-    )(lyr, block_tables, chunk_start, lo, win_arr, qg, kpool, vpool, pos_rep,
+    )(lyr, block_tables, chunk_start, lo, win_arr, qg,
+      *([kpool] * K), *([vpool] * K), pos_rep,
       slopes, ckg, cvg, cpos)
     # (B, KVH, C*G, D) → (B, C, H, D)
     return out.reshape(b, kvh, c, group, d).transpose(0, 2, 1, 3, 4).reshape(

@@ -22,16 +22,20 @@ attention scratch per step than the round-3 form.
 """
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..utils import groups
+from .ring_flash import _vary, ring_flash_body, ring_flash_supported
 
 NEG_INF = -1e30
 
 _RING_CACHE = {}
+# entries key on the live mesh; drop them when the mesh is rebuilt
+groups.register_reset_hook(_RING_CACHE.clear)
 
 
 def _block_attend(q, k, v, scale, q_pos, k_pos, window, seg_q, seg_k,
@@ -125,15 +129,9 @@ def _ring_body(q, k, v, seg, axis_name, scale, window, slopes, vary_axes=None):
         return m_new, l_new, o_new, kv_next
 
     axes = tuple(vary_axes) if vary_axes else (axis_name,)
-
-    def _vary(x):
-        if hasattr(jax.lax, "pcast"):
-            return jax.lax.pcast(x, axes, to="varying")
-        return jax.lax.pvary(x, axes)
-
-    m0 = _vary(jnp.full((b, h, sq), NEG_INF, jnp.float32))
-    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32))
-    o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32))
+    m0 = _vary(jnp.full((b, h, sq), NEG_INF, jnp.float32), axes)
+    l0 = _vary(jnp.zeros((b, h, sq), jnp.float32), axes)
+    o0 = _vary(jnp.zeros((b, sq, h, d), jnp.float32), axes)
     step = jax.checkpoint(step, static_argnums=())
     m, l, o, _ = jax.lax.fori_loop(0, n, step, (m0, l0, o0, (k, v, seg)))
     l_safe = jnp.where(l == 0.0, 1.0, l)
@@ -164,17 +162,49 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None,
     vary_axes = (axis_name,) + (batch_axes or ())
     has_seg = segment_ids is not None
 
+    # Pallas ring-flash eligibility (static): scores never leave VMEM —
+    # the einsum body (fp32 (B, H, Cq, S/n) HBM chunks) stays as the
+    # fallback for odd shard shapes / traced windows / non-TPU-unfriendly
+    # head dims, and as the parity reference.
+    n_ring = mesh.shape[axis_name]
+    sq_local = q.shape[1] // max(n_ring, 1)
+    win_static = (None if window is None or
+                  (isinstance(window, int) and window <= 0) else window)
+    # Mosaic cannot lower under a PARTIAL-manual mesh (mixed Manual/Auto
+    # axes): the flash ring goes full-manual, which is semantics-preserving
+    # only when every axis outside {ring, batch} is trivial — tensor-sharded
+    # heads etc. keep the einsum body (XLA partitions around it).
+    manual_axes = {axis_name} | set(batch_axes or ())
+    full_manual_ok = all(size == 1 for a, size in mesh.shape.items()
+                         if a not in manual_axes)
+    use_flash = (os.environ.get("DS_TPU_RING_FLASH", "1") != "0"
+                 and full_manual_ok
+                 and q.shape[1] % max(n_ring, 1) == 0
+                 and ring_flash_supported(sq_local, sq_local, d, win_static))
+
     def build():
-        body = functools.partial(_ring_body, axis_name=axis_name, scale=scale,
-                                 window=window, slopes=slopes,
-                                 vary_axes=vary_axes)
+        if use_flash:
+            body = functools.partial(ring_flash_body, axis_name=axis_name,
+                                     scale=scale, window=win_static,
+                                     slopes=alibi_slopes,
+                                     vary_axes=vary_axes)
+        else:
+            body = functools.partial(_ring_body, axis_name=axis_name,
+                                     scale=scale, window=window,
+                                     slopes=slopes, vary_axes=vary_axes)
         fn = jax.shard_map(
             body if has_seg else functools.partial(body, seg=None),
             mesh=mesh,
             in_specs=(spec, spec, spec) + ((seg_spec,) if has_seg else ()),
             out_specs=spec,
-            axis_names={axis_name} | (set(batch_axes) if batch_axes else set()),
-            check_vma=True)
+            # flash: ALL axes manual (Mosaic rejects partial-manual);
+            # eligibility guarantees the extra axes are trivial
+            axis_names=(set(mesh.shape) if use_flash else
+                        {axis_name} | (set(batch_axes) if batch_axes else set())),
+            # interpret-mode pallas_call strips vma from ref reads, so the
+            # kernel path cannot satisfy the strict vma type system; the
+            # einsum body keeps it on
+            check_vma=not use_flash)
         # jit: the chunked scan inside the manual region cannot evaluate
         # eagerly (free when this call is itself inside an outer jit)
         return jax.jit(fn)
@@ -188,7 +218,7 @@ def ring_attention(q, k, v, *, axis_name: str = "seq", scale=None,
                window if isinstance(window, (int, type(None))) else None,
                None if alibi_slopes is None
                else tuple(float(x) for x in jnp.asarray(alibi_slopes)),
-               has_seg)
+               has_seg, use_flash)
         hashable = isinstance(window, (int, type(None)))
     except Exception:
         hashable = False
