@@ -107,18 +107,14 @@ def pipeline_spmd(layer_fn: Callable, num_stages: int, layers_per_stage: int,
                          check_vma=True)
 
 
-def check_pipeline_model_support(cfg):
-    """Loud rejection of model shapes the compiled pipeline does not thread
-    through its stage loop (silent support would train wrong numerics)."""
-    if getattr(cfg, "post_norm", False) or getattr(cfg, "mlm_head", False) \
-            or not getattr(cfg, "causal", True):
-        raise NotImplementedError(
-            "pipeline engine supports causal pre-norm decoders only; "
-            "train BERT-style encoders under ZeRO (DP/TP/SP) instead")
-    # heterogeneous stacks (cfg.layer_types) and per-layer local/global
-    # window patterns are supported by the 1F1B engine via per-stage slot
-    # tables (see build_pipeline_1f1b); the GPipe autodiff path keeps its
-    # own guards in build_pipeline_loss.
+# Model-support note: since round 5 the compiled 1F1B engine threads
+# post-norm/MLM/non-causal encoders through the stage loop too (the
+# reference pipelines arbitrary LayerSpec lists incl. BERT,
+# ``runtime/pipe/module.py:86``) — segment masks ride the replicated
+# microbatch stream and the MLM head runs inside the last stage's loss
+# cond. Heterogeneous stacks and per-layer windows are 1F1B-supported via
+# per-stage slot tables. Only the legacy GPipe autodiff path keeps guards
+# (``build_pipeline_loss``).
 
 
 def _pipeline_interface(model):
@@ -132,21 +128,32 @@ def _pipeline_interface(model):
     if hasattr(model, "pipe_embed"):
         raw = model.pipe_layer
 
-        def custom_layer(lp, h, tag=None, win=None):   # tag/win unused; no
-            return raw(lp, h), jnp.zeros((), jnp.float32)   # aux in custom
-        return model.pipe_embed, custom_layer, model.pipe_loss
+        def custom_layer(lp, h, tag=None, win=None, seg=None):   # tag/win
+            return raw(lp, h), jnp.zeros((), jnp.float32)   # unused; no aux
+        return model.pipe_embed, custom_layer, model.pipe_loss, lambda b: None
 
     def embed(other, batch_mb):
-        return model.embed_fwd(other["embed"], batch_mb["input_ids"])
+        return model.embed_fwd(other["embed"], batch_mb["input_ids"],
+                               token_type_ids=batch_mb.get("token_type_ids"))
 
-    def layer(lp, h, tag=None, win=None):
-        return model._layer_fn(lp, h, None, None, window=win, layer_type=tag)
+    def layer(lp, h, tag=None, win=None, seg=None):
+        return model._layer_fn(lp, h, None, seg, window=win, layer_type=tag)
 
     def loss(other, h, batch_mb):
         return model.head_loss(other, h, batch_mb["labels"],
                                batch_mb.get("loss_mask"))
 
-    return embed, layer, loss
+    def seg_of(batch_mb):
+        """Attention segment ids for this microbatch: packed-sequence ids
+        when present; for bidirectional encoders the 0/1 padding mask doubles
+        as segment ids (EncoderLM.loss does the same mapping)."""
+        seg = batch_mb.get("segment_ids")
+        if seg is None and not getattr(model.cfg, "causal", True) \
+                and batch_mb.get("attention_mask") is not None:
+            seg = batch_mb["attention_mask"].astype(jnp.int32)
+        return seg
+
+    return embed, layer, loss, seg_of
 
 
 def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
@@ -176,10 +183,8 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
     """
     from .schedule import compile_tick_tables
 
-    if hasattr(model, "cfg"):
-        check_pipeline_model_support(model.cfg)
     mesh = groups.get_mesh()
-    embed_fn, layer_fn, loss_fn = _pipeline_interface(model)
+    embed_fn, layer_fn, loss_fn, seg_fn = _pipeline_interface(model)
     if remat:
         layer_fn = jax.checkpoint(layer_fn, static_argnums=(2,))
 
@@ -274,6 +279,7 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
                 neither (cond runs — and differentiates — only the taken
                 branch)."""
                 bmb = batch_mb(mb_idx)
+                seg = seg_fn(bmb)
                 h = jax.lax.cond(
                     is_first,
                     lambda xx: embed_fn(other_pp, bmb).astype(xx.dtype),
@@ -287,7 +293,7 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
                     def one(carry, xs):
                         hh, aux = carry
                         lp, win = xs if win_tab is not None else (xs, None)
-                        hh, a = layer_fn(lp, hh, None, win)
+                        hh, a = layer_fn(lp, hh, None, win, seg)
                         return (hh, aux + a), None
                     xs = (layers_p, wtab) if win_tab is not None else layers_p
                     (h, aux_sum), _ = jax.lax.scan(one, (h, aux0), xs)
@@ -309,7 +315,8 @@ def build_pipeline_1f1b(model, num_stages: int, eager: bool = False,
                                     a, ix, 0, keepdims=False),
                                 layers_p[f"g{gi}"])
                             return layer_fn(lp, hh, tag,
-                                            win if win_tab is not None else None)
+                                            win if win_tab is not None else None,
+                                            seg)
                         return b
 
                     branches = [branch(gi, tag)
@@ -486,7 +493,12 @@ def build_pipeline_loss(model, num_stages: int):
     """
     from ...models import layers as L
     cfg = model.cfg
-    check_pipeline_model_support(cfg)
+    if getattr(cfg, "post_norm", False) or getattr(cfg, "mlm_head", False) \
+            or not getattr(cfg, "causal", True):
+        raise NotImplementedError(
+            "post-norm/MLM/non-causal encoders pipeline through the 1F1B "
+            "engine (pipeline.schedule='1f1b', the default), not the GPipe "
+            "autodiff path")
     if getattr(model, "_groups", None) is not None:
         raise NotImplementedError(
             "heterogeneous layer stacks pipeline through the 1F1B engine "

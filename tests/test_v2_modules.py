@@ -379,24 +379,23 @@ def test_bert_chunked_loss_matches_dense():
     np.testing.assert_allclose(dense, chunked, rtol=1e-5)
 
 
-def test_pipeline_rejects_encoder_models():
-    """The compiled pipeline must loudly reject post-norm/MLM encoders
-    instead of training wrong numerics. Per-layer local-attention patterns
-    are 1F1B-supported since round 4 (window slot tables) but the GPipe
-    autodiff path still rejects them."""
+def test_pipeline_encoder_support_boundaries():
+    """Since round 5 the 1F1B engine accepts post-norm/MLM encoders (the
+    old check_pipeline_model_support rejection is gone — reference
+    pipelines arbitrary LayerSpec lists incl. BERT, pipe/module.py:86);
+    the legacy GPipe autodiff path still rejects encoders and per-layer
+    window patterns."""
     from deepspeed_tpu.models import build_model
-    from deepspeed_tpu.runtime.pipe.engine import (build_pipeline_loss,
-                                                   check_pipeline_model_support)
+    from deepspeed_tpu.runtime.pipe.engine import build_pipeline_loss
     from deepspeed_tpu.utils import groups
+    from deepspeed_tpu.models.config import TransformerConfig
     bert = build_model("bert-base", num_layers=2, hidden_size=32, num_heads=4,
                        intermediate_size=64, vocab_size=128)
-    with pytest.raises(NotImplementedError):
-        check_pipeline_model_support(bert.cfg)
-    from deepspeed_tpu.models.config import TransformerConfig
-    neo_like = TransformerConfig(sliding_window=8, local_attention_every=2)
-    check_pipeline_model_support(neo_like)   # 1F1B handles this now
     groups.reset_mesh()
     groups.set_mesh(groups.build_mesh(pipe=2, data=4))
+    with pytest.raises(NotImplementedError):
+        build_pipeline_loss(bert, num_stages=2)       # GPipe = legacy
+    neo_like = TransformerConfig(sliding_window=8, local_attention_every=2)
     neo_model = build_model(neo_like.replace(
         vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
         intermediate_size=64, dtype="float32"))
