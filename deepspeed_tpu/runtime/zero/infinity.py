@@ -199,11 +199,6 @@ class InfinityRunner:
         from ...models.transformer import CausalLM
         if not isinstance(model, CausalLM):
             raise NotImplementedError("ZeRO-Infinity streaming requires a native CausalLM")
-        if model.cfg.post_norm or model.cfg.mlm_head or not model.cfg.causal:
-            raise NotImplementedError(
-                "ZeRO-Infinity streaming supports causal pre-norm decoders "
-                "only (its persistent head fabricates final_norm and uses "
-                "the causal head_loss)")
         self.model = model
         self.mesh = mesh
         self.cfg = model.cfg
@@ -212,23 +207,20 @@ class InfinityRunner:
         if L % self.group_layers != 0:
             raise ValueError(f"num_layers {L} not divisible by group size {self.group_layers}")
         self.n_groups = L // self.group_layers
-        # heterogeneous stacks stream in original layer order; each
-        # streaming group must be type-homogeneous so its layers stack
-        # under one treedef (group_layers=1 admits ANY cfg.layer_types)
-        self._group_tags = []
-        for gi in range(self.n_groups):
-            tags = {self.cfg.layer_type(i)
-                    for i in range(gi * self.group_layers,
-                                   (gi + 1) * self.group_layers)}
-            if len(tags) > 1:
-                raise ValueError(
-                    f"streaming group {gi} mixes layer types {sorted(tags)}; "
-                    "set stream_group_layers so groups align with "
-                    "cfg.layer_types runs (stream_group_layers=1 always "
-                    "works)")
-            self._group_tags.append(tags.pop())
+        # heterogeneous stacks stream in original layer order. A group's tag
+        # tuple drives its compiled form: homogeneous groups scan stacked
+        # layers; MIXED groups (r5) unroll a per-layer loop over a tuple of
+        # per-layer trees — any group_layers composes with any
+        # cfg.layer_types (reference stage3+swap is model-agnostic).
+        self._group_tags = [
+            tuple(self.cfg.layer_type(i)
+                  for i in range(gi * self.group_layers,
+                                 (gi + 1) * self.group_layers))
+            for gi in range(self.n_groups)]
+        self._group_mixed = [len(set(t)) > 1 for t in self._group_tags]
         self._n_moe = sum(1 for i in range(L)
                           if self.cfg.layer_type(i) == "moe") or 1
+        self._segmented = not self.cfg.causal   # encoders mask by segments
         # per-layer local/global window patterns ride the group scan as xs
         self._windows_host = None
         if self.cfg.window_pattern is not None or (
@@ -259,9 +251,19 @@ class InfinityRunner:
         r_emb, r_layers = jax.random.split(rng)
         from ...models import layers as ML
         emb = jax.jit(lambda r: ML.init_embeddings(r, cfg)[0])(r_emb)
-        fnorm, _ = ML.init_norm(cfg)
+        # the persistent (never-streamed) head follows the model family:
+        # final_norm for pre-norm decoders, the MLM transform head for BERT
+        # (post-norm encoders have no final norm) — head_loss dispatch picks
+        # the right loss for whichever keys are present
+        persist_p = {"embed": emb}
+        if not cfg.post_norm:
+            persist_p["final_norm"] = ML.init_norm(cfg)[0]
+        if cfg.mlm_head:
+            from ...models.bert import init_mlm_head
+            persist_p["mlm"] = jax.jit(
+                lambda r: init_mlm_head(r, cfg)[0])(jax.random.fold_in(rng, 0x3A))
         self.persist = {
-            "p": jax.tree.map(lambda x: np.asarray(x, np.float32), {"embed": emb, "final_norm": fnorm}),
+            "p": jax.tree.map(lambda x: np.asarray(x, np.float32), persist_p),
         }
         self.persist["m"] = jax.tree.map(lambda x: np.zeros_like(x), self.persist["p"])
         self.persist["v"] = jax.tree.map(lambda x: np.zeros_like(x), self.persist["p"])
@@ -269,20 +271,34 @@ class InfinityRunner:
 
         layer_rngs = jax.random.split(r_layers, cfg.num_layers)
         init_by_tag = {}
-        self._group_treedefs = [None] * self.n_groups
-        for gi in range(self.n_groups):
-            tag = self._group_tags[gi]
+
+        def init_layer(tag, r):
             if tag not in init_by_tag:
                 init_by_tag[tag] = jax.jit(functools.partial(
-                    lambda r, t: self.model._init_layer(r, layer_type=t)[0],
+                    lambda rr, t: self.model._init_layer(rr, layer_type=t)[0],
                     t=tag))
-            per = []
-            for li in range(gi * self.group_layers, (gi + 1) * self.group_layers):
-                lp = init_by_tag[tag](layer_rngs[li])
-                leaves, td = jax.tree.flatten(lp)
+            return init_by_tag[tag](r)
+
+        self._group_treedefs = [None] * self.n_groups
+        for gi in range(self.n_groups):
+            tags = self._group_tags[gi]
+            rngs = layer_rngs[gi * self.group_layers:(gi + 1) * self.group_layers]
+            if self._group_mixed[gi]:
+                # mixed group: a TUPLE of per-layer trees, leaves stored
+                # unstacked (the compiled form unrolls over the tuple)
+                lp_tuple = tuple(init_layer(t, r) for t, r in zip(tags, rngs))
+                leaves, td = jax.tree.flatten(lp_tuple)
                 self._group_treedefs[gi] = td
-                per.append([np.asarray(x, np.float32) for x in leaves])
-            stacked = [np.stack([row[j] for row in per]) for j in range(len(per[0]))]
+                stacked = [np.asarray(x, np.float32) for x in leaves]
+            else:
+                per = []
+                for li, r in enumerate(rngs):
+                    lp = init_layer(tags[0], r)
+                    leaves, td = jax.tree.flatten(lp)
+                    self._group_treedefs[gi] = td
+                    per.append([np.asarray(x, np.float32) for x in leaves])
+                stacked = [np.stack([row[j] for row in per])
+                           for j in range(len(per[0]))]
             self.store.put(gi, {"p": stacked,
                                 "m": [np.zeros_like(a) for a in stacked],
                                 "v": [np.zeros_like(a) for a in stacked]})
@@ -295,44 +311,63 @@ class InfinityRunner:
         act = self.cfg.act_dtype
         has_win = self._windows_host is not None
 
-        def embed_fwd(emb, ids):
-            return model.embed_fwd(emb, ids)
+        def embed_fwd(emb, ids, tt):
+            return model.embed_fwd(emb, ids, token_type_ids=tt)
 
-        def make_fwd(tag):
-            def fwd_group(gp, h, positions, wins):
-                def body(carry, xs):
-                    h, aux = carry
-                    lp, win = xs if has_win else (xs, None)
-                    h2, a = model._layer_fn(lp, h, positions, None,
-                                            window=win, layer_type=tag)
-                    return (h2, aux + a), None
-                xs = (gp, wins) if has_win else gp
-                (h, aux), _ = jax.lax.scan(
-                    body, (h, jnp.zeros((), jnp.float32)), xs)
+        def make_fwd(tags):
+            if len(set(tags)) == 1:
+                tag = tags[0]
+
+                def fwd_group(gp, h, positions, wins, seg):
+                    def body(carry, xs):
+                        h, aux = carry
+                        lp, win = xs if has_win else (xs, None)
+                        h2, a = model._layer_fn(lp, h, positions, seg,
+                                                window=win, layer_type=tag)
+                        return (h2, aux + a), None
+                    xs = (gp, wins) if has_win else gp
+                    (h, aux), _ = jax.lax.scan(
+                        body, (h, jnp.zeros((), jnp.float32)), xs)
+                    return h, aux
+                return fwd_group
+
+            def fwd_group_mixed(gp, h, positions, wins, seg):
+                # mixed group: per-layer tag dispatch is static, so the
+                # group unrolls (group sizes are small by construction)
+                aux = jnp.zeros((), jnp.float32)
+                for i, (lp, tag) in enumerate(zip(gp, tags)):
+                    win = wins[i] if has_win else None
+                    h, a = model._layer_fn(lp, h, positions, seg,
+                                           window=win, layer_type=tag)
+                    aux = aux + a
                 return h, aux
-            return fwd_group
+            return fwd_group_mixed
 
-        def make_bwd(tag):
-            fwd = make_fwd(tag)
+        def make_bwd(tags):
+            fwd = make_fwd(tags)
 
-            def bwd_group(gp, h, positions, wins, dh, daux):
+            def bwd_group(gp, h, positions, wins, seg, dh, daux):
                 _, vjp = jax.vjp(
-                    lambda gp_, h_: fwd(gp_, h_, positions, wins), gp, h)
+                    lambda gp_, h_: fwd(gp_, h_, positions, wins, seg), gp, h)
                 dgp, dh_in = vjp((dh, daux))
                 return dgp, dh_in
             return bwd_group
 
-        def head(head_params, h, labels):
-            return model.head_loss(head_params, h, labels)
+        def head(head_params, h, labels, loss_mask):
+            # EncoderLM overrides head_loss with the MLM transform + the
+            # labels!=-100 ignore convention; the call is family-agnostic
+            return model.head_loss(head_params, h, labels, loss_mask)
 
-        def head_bwd(head_params, h, labels, seed):
+        def head_bwd(head_params, h, labels, loss_mask, seed):
             # fp16: the loss scale enters through the cotangent seed
-            (loss), vjp = jax.vjp(lambda hp, h_: head(hp, h_, labels), head_params, h)
+            (loss), vjp = jax.vjp(lambda hp, h_: head(hp, h_, labels,
+                                                      loss_mask),
+                                  head_params, h)
             dhp, dh = vjp(seed.astype(jnp.float32))
             return loss, dhp, dh
 
-        def embed_bwd(emb, ids, dh):
-            _, vjp = jax.vjp(lambda e: embed_fwd(e, ids), emb)
+        def embed_bwd(emb, ids, tt, dh):
+            _, vjp = jax.vjp(lambda e: embed_fwd(e, ids, tt), emb)
             return vjp(dh)[0]
 
         self._embed_fwd = jax.jit(embed_fwd)
@@ -369,7 +404,8 @@ class InfinityRunner:
 
     # ---------------- the step ----------------
 
-    def _microbatch_grads(self, ids, labels, loss_scale):
+    def _microbatch_grads(self, ids, labels, loss_scale, seg=None,
+                          tt=None, loss_mask=None):
         """One fwd/bwd streaming sweep; returns (loss, ce+aux host loss
         pieces, per-group HOST grads list, persist grads, gsq of this
         microbatch's grads). The head cotangent is seeded with
@@ -383,14 +419,15 @@ class InfinityRunner:
 
         # ---- forward: stream groups with +1 prefetch ----
         self._upload_group(0)
-        h = self._embed_fwd(emb_dev["embed"], ids)
+        h = self._embed_fwd(emb_dev["embed"], ids, tt)
         boundaries = [h]
         aux_parts = []   # device scalars; a float() here would sync the
         # host per group and kill the prefetch/compute overlap
         for gi in range(self.n_groups):
             self._upload_group(gi + 1)  # prefetch while gi computes
             h, aux = self._fwd_by_tag[self._group_tags[gi]](
-                self._dev_groups[gi], h, positions, self._group_windows(gi))
+                self._dev_groups[gi], h, positions, self._group_windows(gi),
+                seg)
             aux_parts.append(aux)
             boundaries.append(h)
             if gi < self.n_groups - 1:
@@ -401,7 +438,8 @@ class InfinityRunner:
 
         # ---- head loss + its grads ----
         seed = jnp.float32(loss_scale)
-        ce, d_head, dh = self._head_bwd(emb_dev, boundaries[-1], labels, seed)
+        ce, d_head, dh = self._head_bwd(emb_dev, boundaries[-1], labels,
+                                        loss_mask, seed)
         # MoE router aux joins the loss (CausalLM.loss semantics); its
         # gradient enters every group's backward as a constant aux seed
         aux_coef = (cfg.moe_aux_loss_coef / self._n_moe) if cfg.is_moe else 0.0
@@ -414,7 +452,7 @@ class InfinityRunner:
             self._upload_group(gi - 1)  # prefetch for the next iteration
             dgp, dh = self._bwd_by_tag[self._group_tags[gi]](
                 self._dev_groups[gi], boundaries[gi], positions,
-                self._group_windows(gi), dh, daux)
+                self._group_windows(gi), seg, dh, daux)
             for x in jax.tree.leaves(dgp):
                 x.copy_to_host_async()
             host = [np.asarray(x, np.float32) for x in jax.tree.leaves(dgp)]
@@ -423,11 +461,13 @@ class InfinityRunner:
             self._drop_group(gi)
 
         # ---- embedding grads (+ tied head contribution via d_head) ----
-        d_emb = self._embed_bwd(emb_dev["embed"], ids, dh)
-        d_persist = {"embed": d_emb, "final_norm": d_head["final_norm"]}
-        d_persist = jax.tree.map(jnp.add, d_persist,
-                                 {"embed": d_head["embed"],
-                                  "final_norm": jax.tree.map(jnp.zeros_like, d_head["final_norm"])})
+        # d_head is the cotangent of the WHOLE persist tree (final_norm /
+        # mlm head / tied embed weight); the input-embedding grad adds into
+        # its "embed" leaf — key-generic so every model family's persistent
+        # head flows through unchanged
+        d_emb = self._embed_bwd(emb_dev["embed"], ids, tt, dh)
+        d_persist = dict(d_head)
+        d_persist["embed"] = jax.tree.map(jnp.add, d_head["embed"], d_emb)
         d_persist = [np.asarray(x, np.float32)
                      for x in jax.tree.leaves(d_persist)]
         gsq += sum(float(np.vdot(a, a)) for a in d_persist)
@@ -449,9 +489,26 @@ class InfinityRunner:
         cfg = self.cfg
         ids_all = np.asarray(batch["input_ids"])
         labels_all = np.asarray(batch["labels"])
+        seg_all = batch.get("segment_ids")
+        if seg_all is None and self._segmented \
+                and batch.get("attention_mask") is not None:
+            # encoders: the 0/1 padding mask doubles as segment ids
+            seg_all = np.asarray(batch["attention_mask"], np.int32)
+        elif seg_all is not None:
+            seg_all = np.asarray(seg_all, np.int32)
+        tt_all = batch.get("token_type_ids")
+        tt_all = None if tt_all is None else np.asarray(tt_all, np.int32)
+        lm_all = batch.get("loss_mask")
+        lm_all = None if lm_all is None else np.asarray(lm_all, np.float32)
         if ids_all.ndim == 2:
             ids_all = ids_all.reshape(gas, -1, ids_all.shape[-1])
             labels_all = labels_all.reshape(gas, -1, labels_all.shape[-1])
+            seg_all = (None if seg_all is None
+                       else seg_all.reshape(gas, -1, seg_all.shape[-1]))
+            tt_all = (None if tt_all is None
+                      else tt_all.reshape(gas, -1, tt_all.shape[-1]))
+            lm_all = (None if lm_all is None
+                      else lm_all.reshape(gas, -1, lm_all.shape[-1]))
 
         acc_groups = None
         acc_persist = None
@@ -460,8 +517,14 @@ class InfinityRunner:
         for mb in range(gas):
             ids = jnp.asarray(ids_all[mb], jnp.int32)
             labels = jnp.asarray(labels_all[mb], jnp.int32)
+            seg = (None if seg_all is None
+                   else jnp.asarray(seg_all[mb], jnp.int32))
+            tt = (None if tt_all is None
+                  else jnp.asarray(tt_all[mb], jnp.int32))
+            lm = (None if lm_all is None
+                  else jnp.asarray(lm_all[mb], jnp.float32))
             loss, group_grads, d_persist, gsq = self._microbatch_grads(
-                ids, labels, loss_scale)
+                ids, labels, loss_scale, seg, tt, lm)
             losses.append(loss)
             gsq_total += gsq   # upper-bounds the summed-grad norm; exact at gas=1
             if acc_groups is None:
@@ -554,9 +617,15 @@ class InfinityRunner:
         per_layer = {}   # global layer index -> (treedef, leaf rows)
         for gi in range(self.n_groups):
             st = self.store.fetch(gi)
-            for row in range(self.group_layers):
-                per_layer[gi * self.group_layers + row] = (
-                    self._group_treedefs[gi], [a[row] for a in st["p"]])
+            if self._group_mixed[gi]:
+                lp_tuple = jax.tree.unflatten(self._group_treedefs[gi], st["p"])
+                for row, lp in enumerate(lp_tuple):
+                    leaves, td = jax.tree.flatten(lp)
+                    per_layer[gi * self.group_layers + row] = (td, leaves)
+            else:
+                for row in range(self.group_layers):
+                    per_layer[gi * self.group_layers + row] = (
+                        self._group_treedefs[gi], [a[row] for a in st["p"]])
             self.store.evict_to_budget(keep=[gi])
 
         def stack(idxs):
@@ -570,6 +639,4 @@ class InfinityRunner:
         else:
             layers = {f"g{k}": stack(list(idxs))
                       for k, (_, idxs) in enumerate(self.model._groups)}
-        return {"embed": self.persist["p"]["embed"],
-                "layers": layers,
-                "final_norm": self.persist["p"]["final_norm"]}
+        return {**self.persist["p"], "layers": layers}
