@@ -86,6 +86,76 @@ def head_prune(w_heads, num_keep: int):
     return w_heads * mask.reshape(shape)
 
 
+def _mlp_channel_norms(mlp):
+    """Per-intermediate-channel L2 norm of the block's input weights —
+    (…, F) for (…, E, F) weights; gated MLPs sum gate+up contributions."""
+    parts = [mlp[k] for k in ("wi", "wi_gate", "wi_up") if k in mlp]
+    sq = sum(jnp.sum(jnp.square(p.astype(jnp.float32)), axis=-2)
+             for p in parts)
+    return jnp.sqrt(sq)
+
+
+def row_prune_mlp(mlp, dense_ratio: float, dim_reduction: bool = False):
+    """Structured row/channel pruning of one MLP block (reference
+    ``compression/basic_layer.py:166 enable_row_pruning`` + ``:212
+    fix_row_col_pruning_helper``): the intermediate channels with the
+    smallest input-weight norms are pruned — the producing weights' OUTPUT
+    rows and the consuming ``wo``'s INPUT rows together, so the block's
+    function only loses the dropped channels.
+
+    ``dim_reduction=False`` (training): channels are MASKED to zero, shapes
+    unchanged — the QAT-style stage. ``dim_reduction=True``
+    (redundancy_clean): weights are physically SLICED to F' =
+    round(F * dense_ratio); the caller serves/trains the result under a
+    config with the reduced intermediate size. Works on stacked (L, E, F)
+    layer trees (per-layer channel choice) and single blocks.
+    """
+    f = mlp["wo"].shape[-2]
+    k = max(1, int(round(f * float(dense_ratio))))
+    norms = _mlp_channel_norms(mlp)                       # (..., F)
+    keep = jnp.sort(jnp.argsort(norms, axis=-1)[..., f - k:], axis=-1)
+
+    def take_last(w):     # gather along the last (channel) dim
+        idx = jnp.broadcast_to(keep[..., None, :], w.shape[:-1] + (k,))
+        return jnp.take_along_axis(w, idx.astype(jnp.int32), axis=-1)
+
+    def take_rows(w):     # gather wo's input (second-to-last) dim
+        idx = jnp.broadcast_to(keep[..., :, None], w.shape[:-2] + (k, w.shape[-1]))
+        return jnp.take_along_axis(w, idx.astype(jnp.int32), axis=-2)
+
+    new = dict(mlp)
+    if dim_reduction:
+        for key in ("wi", "wi_gate", "wi_up"):
+            if key in new:
+                new[key] = take_last(new[key])
+        if "bi" in new:
+            new["bi"] = jnp.take_along_axis(new["bi"], keep.astype(jnp.int32),
+                                            axis=-1)
+        new["wo"] = take_rows(new["wo"])
+        return new
+    mask = jax.nn.one_hot(keep, f, dtype=mlp["wo"].dtype).sum(axis=-2)
+    for key in ("wi", "wi_gate", "wi_up"):
+        if key in new:
+            new[key] = new[key] * mask[..., None, :]
+    if "bi" in new:
+        new["bi"] = new["bi"] * mask
+    new["wo"] = new["wo"] * mask[..., :, None]
+    return new
+
+
+def _map_mlps(tree, fn, patterns=None, prefix=""):
+    """Apply ``fn`` to every MLP block ({wi|wi_gate, wo} dict) whose dotted
+    path matches one of ``patterns`` (None = every block)."""
+    if isinstance(tree, dict):
+        if "wo" in tree and ("wi" in tree or "wi_gate" in tree):
+            if patterns is None or _match(prefix[:-1], patterns):
+                return fn(tree)
+            return tree
+        return {k: _map_mlps(v, fn, patterns, f"{prefix}{k}.")
+                for k, v in tree.items()}
+    return tree
+
+
 def _match(path: str, patterns):
     return any(p in path for p in patterns)
 
@@ -130,13 +200,38 @@ def init_compression(model_or_params, deepspeed_config: Dict, teacher_model=None
             params = _apply_to_params(
                 params, lambda w: magnitude_prune(w, 1.0 - float(dense_ratio)), mods)
             logger.info(f"compression: pruning to dense_ratio={dense_ratio} on {mods}")
+
+    rp = comp.get("row_pruning", {}).get("shared_parameters", {})
+    if rp.get("enabled", False):
+        # training stage: channels masked, shapes unchanged (reference
+        # enable_row_pruning); redundancy_clean does the dim reduction
+        for gname, g in comp["row_pruning"].get("different_groups", {}).items():
+            dense_ratio = float(g.get("params", {}).get("dense_ratio", 0.5))
+            mods = g.get("modules")      # None = every MLP block
+            params = _map_mlps(params,
+                               lambda m: row_prune_mlp(m, dense_ratio), mods)
+            logger.info(f"compression: row pruning (masked) to "
+                        f"dense_ratio={dense_ratio} on {mods or 'all MLPs'}")
     return params
 
 
 def redundancy_clean(model_or_params, deepspeed_config: Dict, mpu=None):
-    """Layer-reduction (reference redundancy_clean): keep the configured
-    subset of layers from the stacked layer dim."""
+    """Reference redundancy_clean: make training-time compression PHYSICAL —
+    layer reduction slices the stacked layer dim; row pruning slices the
+    masked intermediate channels out of every MLP (the
+    ``fix_row_col_pruning_helper(dim_reduction=True)`` analog) — serve the
+    result under a config with the matching reduced intermediate size."""
     params = model_or_params
+    comp = deepspeed_config.get("compression_training", {})
+    rp = comp.get("row_pruning", {}).get("shared_parameters", {})
+    if rp.get("enabled", False):
+        for gname, g in comp["row_pruning"].get("different_groups", {}).items():
+            dense_ratio = float(g.get("params", {}).get("dense_ratio", 0.5))
+            mods = g.get("modules")
+            params = _map_mlps(params, lambda m: row_prune_mlp(
+                m, dense_ratio, dim_reduction=True), mods)
+            logger.info(f"row pruning: dims reduced to "
+                        f"dense_ratio={dense_ratio} on {mods or 'all MLPs'}")
     lr_cfg = deepspeed_config.get("compression_training", {}).get("layer_reduction", {})
     if not lr_cfg.get("enabled", False):
         return params

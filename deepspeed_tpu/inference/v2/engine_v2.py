@@ -36,7 +36,15 @@ class RaggedInferenceEngineConfig:
     # 128 measured best on v5e decode (page-DMA bound: fewer, larger page
     # fetches beat 64; 256 over-fetches for short tails)
     kv_block_size: int = 128
-    num_kv_blocks: Optional[int] = None      # None → sized from memory fraction
+    num_kv_blocks: Optional[int] = None      # explicit override wins
+    # workload-driven pool sizing (r4 review: memory-fraction defaults left
+    # decode rows at 25% utilization and the decode-collapse probe showed a
+    # 1.4x throughput cost to oversizing): provision for the EXPECTED live
+    # context/concurrency, not the theoretical max. Sequences beyond the
+    # estimate still run while free blocks last (admission control gates
+    # the rest); None falls back to the worst case (max_seq_len / batch).
+    expected_context: Optional[int] = None   # avg live tokens per sequence
+    expected_concurrency: Optional[int] = None   # avg live sequences
     prefill_chunk_size: int = 128            # Dynamic SplitFuse chunk
     max_tokens_per_step: int = 512           # token budget per step
     max_tracked_sequences: int = 2048
@@ -64,7 +72,11 @@ class InferenceEngineV2:
         c = self._config
         bs = c.kv_block_size
         max_blocks_per_seq = (self.max_seq_len + bs - 1) // bs
-        num_blocks = c.num_kv_blocks or (c.max_ragged_batch_size * max_blocks_per_seq + 1)
+        exp_ctx = min(c.expected_context or self.max_seq_len, self.max_seq_len)
+        per_seq = (exp_ctx + 1 + bs - 1) // bs      # +1 lookahead slot
+        conc = min(c.expected_concurrency or c.max_ragged_batch_size,
+                   c.max_ragged_batch_size)
+        num_blocks = c.num_kv_blocks or (conc * per_seq + 1)
         self.kv = BlockedKVCache(cfg.num_layers, cfg.kv_heads, cfg.dims_per_head,
                                  num_blocks=num_blocks, block_size=bs,
                                  dtype=cfg.act_dtype)

@@ -99,6 +99,10 @@ class TransformerConfig:
     attn_impl: str = "auto"
     # remat policy for scan-over-layers ("none"|"full"|"dots")
     remat: str = "none"
+    # partition saved activations: checkpoint-boundary residuals stored with
+    # their SEQUENCE dim sharded over the tensor axis, gathered on use
+    # (reference partition_activations, checkpointing.py:486)
+    partition_activations: bool = False
     # QAT activation fake-quant bits (compression QuantAct analog): each
     # layer's attention/MLP inputs round-trip an int grid with an STE
     # backward; 0 disables

@@ -43,7 +43,7 @@ def _axes_of(init_fn):
     return box[0]
 
 
-def _activation_constraint():
+def _activation_constraint(partition: bool = False):
     """Pin the (B, S, E) scan-carried activation to batch/seq sharding.
 
     Without this, XLA's sharding propagation can derive an embed-dim
@@ -56,8 +56,14 @@ def _activation_constraint():
     if mesh.devices.size == 1:
         return lambda h: h
     from ..parallel import sharding as shd
-    from jax.sharding import NamedSharding
+    from jax.sharding import NamedSharding, PartitionSpec as P
     spec = shd.batch_spec(mesh)
+    if partition and mesh.shape.get("tensor", 1) > 1 and spec[1] is None:
+        # partitioned activations (reference checkpointing.py:486): the
+        # checkpoint-boundary residual IS this scan carry — anchoring its
+        # sequence dim to the tensor axis makes XLA STORE each rank's slice
+        # and all-gather only on use (forward compute + backward recompute)
+        spec = P(spec[0], "tensor", *spec[2:])
 
     sharding = NamedSharding(mesh, spec)
 
@@ -407,7 +413,7 @@ class CausalLM:
         if cfg.position == "learned" and positions is None:
             positions = jnp.broadcast_to(jnp.arange(input_ids.shape[1]), input_ids.shape)
 
-        constrain = _activation_constraint()
+        constrain = _activation_constraint(cfg.partition_activations)
 
         # ALiBi needs no precomputed bias: apply_attention passes the
         # per-head slopes down and the flash kernel builds the term

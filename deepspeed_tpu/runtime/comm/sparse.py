@@ -1,0 +1,49 @@
+"""Sparse (row-wise) gradient allreduce for embedding tables.
+
+Analog of the reference's sparse-gradient path
+(``deepspeed/runtime/engine.py:2518-2587`` sparse_allreduce_bucket /
+sparse_all_gather): for embedding-dominated models the dense (V, E) gradient
+allreduce moves mostly zeros — each rank's gradient touches at most its own
+batch's token rows. The reference all-gathers (indices, values) pairs of
+torch sparse tensors; the TPU mapping keeps shapes STATIC: every rank
+contributes exactly N = tokens-per-rank rows (duplicate token ids inside a
+rank are pre-summed by the dense scatter-add of the lookup's vjp, so the
+first occurrence carries the full row and repeats are zeroed), the (W, N)
+ids + (W, N, E) rows ride one all-gather each over ICI, and a scatter-add
+rebuilds the reduced dense gradient locally.
+
+Comm volume: 2·(V·E) per rank for the dense ring vs (W-1)·N·(E+1) here —
+the win is V / (W·N), e.g. 50k-vocab at 2k tokens/rank on 8 ranks ≈ 3x.
+
+Correctness requires the table's gradient to be SPARSE by construction —
+i.e. produced only by input lookups. Tied-embedding models get a dense
+lm-head contribution in the same leaf and must keep the dense reduce (the
+reference's torch sparse grads impose the same restriction: only
+``sparse=True`` embedding layers produce sparse grads).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def sparse_embedding_allreduce(grad, token_ids, axis_name: str = "data"):
+    """Row-sparse allreduce inside a shard_map manual region.
+
+    grad: (V, E) this rank's dense embedding gradient; token_ids: int array
+    of this rank's batch token ids (any shape — flattened). Returns the
+    (V, E) gradient summed across ``axis_name``, bit-equal in structure to a
+    dense ``psum`` but exchanging only touched rows.
+    """
+    v, e = grad.shape
+    flat = token_ids.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(flat)
+    s = flat[order]
+    # first occurrence of each id carries the (already locally-summed) row;
+    # duplicates contribute zero so the cross-rank scatter-add never
+    # double-counts
+    first = jnp.concatenate([jnp.ones((1,), bool), s[1:] != s[:-1]])
+    rows = grad[s] * first[:, None].astype(grad.dtype)
+    all_ids = jax.lax.all_gather(s, axis_name)          # (W, N)
+    all_rows = jax.lax.all_gather(rows, axis_name)      # (W, N, E)
+    return jnp.zeros_like(grad).at[all_ids.reshape(-1)].add(
+        all_rows.reshape(-1, e))

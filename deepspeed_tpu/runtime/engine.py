@@ -345,6 +345,13 @@ class DeepSpeedEngine:
             ac = self._config.activation_checkpointing
             if ac.policy != "none" and target.cfg.remat == "none":
                 target.cfg = target.cfg.replace(remat=ac.policy)
+            if ac.cpu_checkpointing and target.cfg.remat in ("none", "dots",
+                                                             "dots_no_batch"):
+                # reference cpu_checkpointing: saved matmul outputs parked in
+                # host memory, streamed back for the backward
+                target.cfg = target.cfg.replace(remat="dots_offload")
+            if ac.partition_activations and not target.cfg.partition_activations:
+                target.cfg = target.cfg.replace(partition_activations=True)
 
     def _configure_optimizer(self, client_optimizer) -> Optimizer:
         opt = self._build_base_optimizer(client_optimizer)
@@ -646,6 +653,10 @@ class DeepSpeedEngine:
         self._onebit = getattr(self.optimizer, "name", "").startswith(("onebit", "zero_one"))
         if self._onebit:
             self._prepare_onebit()
+        self._sparse_grads = bool(getattr(self._config,
+                                          "sparse_gradients_enabled", False))
+        if self._sparse_grads:
+            self._prepare_sparse_grads()
 
         @functools.partial(jax.jit,
                            out_shardings=(self._replicated, self.grad_shardings))
@@ -702,6 +713,105 @@ class DeepSpeedEngine:
         self._update_fn = update_fn
         self._train_step_fn = train_step_fn
 
+    def _prepare_sparse_grads(self):
+        """Sparse (row-wise) embedding-gradient allreduce (reference
+        ``engine.py:2518 sparse_allreduce_bucket``; config
+        ``sparse_gradients``): the embedding table's gradient rides a
+        touched-rows all-gather over the data axis instead of the dense
+        (V, E) allreduce. Like the reference's torch-sparse grads this
+        needs the table's grad to come only from input lookups."""
+        from ..models.transformer import CausalLM
+        if self.zero_stage > 1:
+            raise NotImplementedError(
+                "sparse_gradients requires zero_optimization.stage <= 1 "
+                "(stages 2/3 reduce-scatter into sharded grad layouts)")
+        for ax in ("tensor", "pipe", "seq", "expert", "zrep"):
+            if self.mesh.shape.get(ax, 1) > 1:
+                raise NotImplementedError(
+                    f"sparse_gradients supports a pure data mesh (got {ax}>1)")
+        if isinstance(self.model, CausalLM) and self.model.cfg.tie_embeddings:
+            raise NotImplementedError(
+                "sparse_gradients is incompatible with tied embeddings: the "
+                "lm-head contribution makes the table's gradient dense "
+                "(reference restriction: only sparse=True embedding layers)")
+        if self._config.fp16.enabled:
+            raise NotImplementedError("sparse_gradients requires bf16/fp32")
+        paths = [jax.tree_util.keystr(kp) for kp, _ in
+                 jax.tree_util.tree_flatten_with_path(self.module_params)[0]]
+        if not any("embed" in p and "tok" in p for p in paths):
+            raise NotImplementedError(
+                "sparse_gradients needs an embedding table at "
+                "params['embed']['tok'] (the leaf whose gradient is "
+                "row-sparse); this model has none")
+        self._sparse_grad_fn = None
+
+    def _compile_sparse_grad_fn(self):
+        from .comm.sparse import sparse_embedding_allreduce
+        mesh = self.mesh
+
+        @functools.partial(jax.jit, static_argnames=("gas",),
+                           out_shardings=(None, self._replicated))
+        def sparse_grads(params, batch, gas):
+            flat_p, treedef = jax.tree.flatten(params)
+            # locate the embedding-table leaf by path
+            paths = [jax.tree_util.keystr(kp) for kp, _ in
+                     jax.tree_util.tree_flatten_with_path(params)[0]]
+            tok_idx = next(i for i, p in enumerate(paths)
+                           if "embed" in p and "tok" in p)
+            batch_specs = jax.tree.map(lambda _: P(None, "data"), batch)
+
+            def body(params_, batch_local):
+                def micro(carry, mb):
+                    acc, ls = carry
+                    loss, g = jax.value_and_grad(self.model.loss)(params_, mb)
+                    return (jax.tree.map(
+                        lambda a, x: a + x.astype(jnp.float32), acc, g),
+                            ls + loss), None
+
+                acc0 = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                    params_)
+                (acc, loss_sum), _ = jax.lax.scan(
+                    micro, (acc0, jnp.zeros((), jnp.float32)), batch_local)
+                flat_g = treedef.flatten_up_to(acc)
+                ids = batch_local["input_ids"]
+                out = [sparse_embedding_allreduce(g, ids, "data")
+                       if i == tok_idx else jax.lax.psum(g, "data")
+                       for i, g in enumerate(flat_g)]
+                return treedef.unflatten(out), jax.lax.pmean(loss_sum, "data")
+
+            fn = jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P(), batch_specs), out_specs=(P(), P()),
+                axis_names={"data"}, check_vma=False)
+            grads, loss_sum = fn(params, batch)
+            return grads, loss_sum / gas
+
+        return sparse_grads
+
+    def _sparse_grads_train_batch(self, batch):
+        if self._sparse_grad_fn is None:
+            self._sparse_grad_fn = self._compile_sparse_grad_fn()
+        gas = self.gradient_accumulation_steps()
+        batch = jax.tree.map(self._stage_leaf, batch)
+        self.tput_timer.start()
+        lr = self._next_lr_device()
+        self._swap_in_opt_state()
+        dp = groups.get_data_parallel_world_size()
+        grads, loss = self._sparse_grad_fn(self.module_params, batch, gas=gas)
+        # grads are SUMS over ranks and microbatches: divide like the fused
+        # step (dp enters because the manual psum sums rather than means)
+        (self.module_params, self.opt_state, self.scaler_state, overflow,
+         grad_norm) = self._update_fn(self.module_params, self.opt_state,
+                                      self.scaler_state, grads, lr,
+                                      float(gas * dp))
+        self._swap_out_opt_state()
+        self.micro_steps += gas
+        self.global_steps += 1
+        self.global_samples += self.train_batch_size()
+        self._post_step(overflow, grad_norm, loss)
+        self.tput_timer.stop(global_step=True)
+        return loss
+
     def _prepare_onebit(self):
         """Set up the COMPRESSED-communication stage of the 1-bit optimizers
         (reference ``runtime/fp16/onebit/adam.py:14``): after ``freeze_step``,
@@ -716,10 +826,16 @@ class DeepSpeedEngine:
                 "(reference constraint): set zero_optimization.stage=0")
         if self._config.fp16.enabled:
             raise NotImplementedError("1-bit compressed stage requires bf16/fp32")
-        for ax in ("tensor", "pipe", "seq", "expert", "zrep"):
+        # the compressed exchange is manual over `data` only; tensor-sharded
+        # params/grads ride through the region auto-partitioned (the same
+        # partial-manual composition the ZeRO++ step uses), so TP composes.
+        # pipe/seq/expert reshape the step itself (schedules, all-to-alls)
+        # and stay excluded, as in the reference's DP-group-only exchange.
+        for ax in ("pipe", "seq", "expert", "zrep"):
             if self.mesh.shape.get(ax, 1) > 1:
                 raise NotImplementedError(
-                    f"1-bit compressed comm supports a pure data mesh (got {ax}>1)")
+                    f"1-bit compressed comm supports data x tensor meshes "
+                    f"(got {ax}>1)")
         self._onebit_freeze_step = int(self.optimizer.hyper.get("freeze_step", 100_000))
         self._onebit_errors = None
         self._onebit_fn = None
@@ -1318,6 +1434,8 @@ class DeepSpeedEngine:
         if getattr(self, "_onebit", False) and \
                 self.global_steps + 1 > self._onebit_freeze_step:
             return self._onebit_compressed_train_batch(batch)
+        if getattr(self, "_sparse_grads", False):
+            return self._sparse_grads_train_batch(batch)
         gas = self.gradient_accumulation_steps()
         batch = jax.tree.map(self._stage_leaf, batch)
         self.tput_timer.start()

@@ -680,3 +680,53 @@ def test_op_builder_prebuild_all():
     results = build_all(verbose=False)
     assert set(results) == {cls().name for cls in ALL_OPS.values()}
     assert all(s.startswith(("ok", "skipped")) for s in results.values()), results
+
+
+def test_row_pruning_masks_trains_and_shrinks(mesh_8dp, rng):
+    """Structured row/channel pruning (reference basic_layer.py:166/212):
+    init_compression MASKS the low-norm intermediate channels (train stage);
+    redundancy_clean physically SLICES them (dim_reduction) — the shrunk
+    model's forward equals the masked model's, and the pruned model trains."""
+    from deepspeed_tpu.compression.compress import (init_compression,
+                                                    redundancy_clean)
+    from deepspeed_tpu.models import build_model
+    cfg_kw = dict(vocab_size=256, hidden_size=32, num_layers=2, num_heads=4,
+                  intermediate_size=64, max_seq_len=64, dtype="float32",
+                  activation="gelu", tie_embeddings=True)
+    from deepspeed_tpu.models.config import TransformerConfig
+    model = build_model(TransformerConfig(**cfg_kw))
+    params = model.init(rng)
+    comp = {"compression_training": {"row_pruning": {
+        "shared_parameters": {"enabled": True},
+        "different_groups": {"rp1": {"params": {"dense_ratio": 0.5}}}}}}
+
+    masked = init_compression(params, comp)
+    wi = np.asarray(masked["layers"]["mlp"]["wi"])
+    assert wi.shape == (2, 32, 64)                       # shapes unchanged
+    zero_channels = (np.abs(wi).sum(axis=1) == 0).sum(axis=1)
+    np.testing.assert_array_equal(zero_channels, [32, 32])   # half masked
+
+    # physical dim reduction picks the SAME channels: forwards agree exactly
+    shrunk = redundancy_clean(masked, comp)
+    assert shrunk["layers"]["mlp"]["wi"].shape == (2, 32, 32)
+    assert shrunk["layers"]["mlp"]["wo"].shape == (2, 32, 32)
+    small = build_model(TransformerConfig(**{**cfg_kw, "intermediate_size": 32}))
+    ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 16)))
+    out_masked = model.apply(masked, ids)
+    out_small = small.apply(shrunk, ids)
+    np.testing.assert_allclose(np.asarray(out_masked), np.asarray(out_small),
+                               rtol=1e-5, atol=1e-5)
+
+    # the pruned model trains
+    import deepspeed_tpu as ds
+    engine, _, _, _ = ds.initialize(model=small, config={
+        "train_batch_size": 8,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "steps_per_print": 10 ** 9})
+    engine.module_params = jax.device_put(shrunk, engine.param_shardings)
+    engine._resync_masters_from_params()
+    rng2 = np.random.default_rng(1)
+    bids = rng2.integers(0, 256, (8, 16))
+    losses = [float(engine.train_batch({"input_ids": bids, "labels": bids}))
+              for _ in range(3)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]

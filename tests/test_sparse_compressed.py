@@ -171,3 +171,36 @@ def test_splash_tables_under_jit():
     out = f(q, k, v, tables)
     ref = sparse_flash_attention(q, k, v, cfg.make_layout(S), layout_block=16)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+def test_onebit_adam_compressed_under_tp():
+    """r4 review: the pure-data-mesh restriction was this repo's own, not
+    the reference's (its 1-bit exchange runs over the DP group regardless of
+    MP). data x tensor: the compressed step's manual-data exchange composes
+    with auto tensor sharding and matches the pure-data trajectory."""
+    import deepspeed_tpu as ds
+    from deepspeed_tpu.models import build_model
+    from deepspeed_tpu.utils import groups
+
+    def run(mesh_kw):
+        import jax as _jax
+        groups.reset_mesh()
+        ndev = 1
+        for v in mesh_kw.values():
+            ndev *= v
+        groups.set_mesh(groups.build_mesh(
+            **mesh_kw, devices=_jax.devices()[:ndev]))
+        cfg = {"train_batch_size": 16,
+               "optimizer": {"type": "OneBitAdam",
+                             "params": {"lr": 1e-3, "freeze_step": 3}},
+               "zero_optimization": {"stage": 0},
+               "steps_per_print": 10 ** 9, "seed": 5}
+        engine, _, _, _ = ds.initialize(model=build_model("tiny"), config=cfg)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(0, 256, (16, 32))
+        return [float(engine.train_batch({"input_ids": ids, "labels": ids}))
+                for _ in range(6)]
+
+    ref = run({"data": 4})
+    got = run({"data": 4, "tensor": 2})
+    np.testing.assert_allclose(ref, got, rtol=2e-4, atol=2e-4)
