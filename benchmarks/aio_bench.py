@@ -54,7 +54,13 @@ def main():
     path = os.path.join(d, "aio_bench.bin")
     size = args.size_mb << 20
 
-    best = {"read_gbps": 0.0, "write_gbps": 0.0}
+    # per-regime bests: the swap path (OptimizerSwapper / Infinity _GroupStore)
+    # opens handles BUFFERED, so the buffered number is what training
+    # actually sees — but it rides the page cache on this single-boot-volume
+    # host, so the O_DIRECT row is reported alongside as the raw-device
+    # throughput (r4 review: the cache regime must be stated in the best row)
+    bests = {False: {"read_gbps": 0.0, "write_gbps": 0.0},
+             True: {"read_gbps": 0.0, "write_gbps": 0.0}}
     results = []
     for qd in (4, 8, 16):
         for bs_mb in (1, 8):
@@ -67,16 +73,27 @@ def main():
                     continue
                 results.append({"qd": qd, "bs_mb": bs_mb, "direct": direct,
                                 "read_gbps": round(r, 2), "write_gbps": round(w, 2)})
-                if r > best["read_gbps"]:
-                    best.update(read_gbps=round(r, 2), read_cfg=(qd, bs_mb, direct))
-                if w > best["write_gbps"]:
-                    best.update(write_gbps=round(w, 2), write_cfg=(qd, bs_mb, direct))
+                b = bests[direct]
+                if r > b["read_gbps"]:
+                    b.update(read_gbps=round(r, 2), read_cfg=(qd, bs_mb))
+                if w > b["write_gbps"]:
+                    b.update(write_gbps=round(w, 2), write_cfg=(qd, bs_mb))
     try:
         os.unlink(path)
     except OSError:
         pass
+    best = {
+        **bests[False],
+        "cache_regime": (
+            "BUFFERED (page-cache-assisted): this is the configuration the "
+            "swap path actually uses (AsyncIOHandle default) and benefits "
+            "from on repeated swap-in of hot groups, but it is NOT a "
+            "raw-device number on this single-boot-volume host — see "
+            "best_o_direct for the uncached throughput"),
+    }
     print(json.dumps({"metric": "aio_bandwidth", "unit": "GB/s",
-                      "best": best, "sweep": results}))
+                      "best": best, "best_o_direct": bests[True],
+                      "sweep": results}))
 
 
 if __name__ == "__main__":

@@ -39,13 +39,16 @@ def _logs_to_stderr():
             h.stream = sys.stderr
 
 
-def _mk_engine(model_name, batch, max_seq_len=None):
+def _mk_engine(model_name, batch, max_seq_len=None, expected_context=None):
     from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                       RaggedInferenceEngineConfig)
     from deepspeed_tpu.models import build_model
     cfg = RaggedInferenceEngineConfig(
         max_ragged_batch_size=max(batch, 16),
         max_tokens_per_step=max(batch * 2, 768),
+        # the bench knows its workload; a server would pass its SLA numbers
+        expected_context=expected_context,
+        expected_concurrency=batch if expected_context else None,
     )
     model = build_model(model_name)
     return InferenceEngineV2(model, cfg, max_seq_len=max_seq_len)
@@ -93,8 +96,12 @@ def _kv_util(eng):
 
 
 def bench_decode(model_name, batch, prompt_len, new_tokens):
-    """Decode-heavy: steady-state generation throughput (compiled loop)."""
-    eng = _mk_engine(model_name, batch)
+    """Decode-heavy: steady-state generation throughput (compiled loop).
+    The pool is workload-auto-sized (expected_context = prompt + generation
+    budget) — r4's decode rows sat at 25% utilization on the memory-fraction
+    default."""
+    eng = _mk_engine(model_name, batch,
+                     expected_context=prompt_len + new_tokens)
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, eng.model.cfg.vocab_size, (prompt_len,)).astype(np.int32)
                for _ in range(batch)]
@@ -127,7 +134,7 @@ def bench_decode(model_name, batch, prompt_len, new_tokens):
 
 def bench_prefill(model_name, batch, prompt_len):
     """Prefill-heavy: prompt-token ingestion throughput via SplitFuse chunks."""
-    eng = _mk_engine(model_name, batch)
+    eng = _mk_engine(model_name, batch, expected_context=prompt_len + 1)
     rng = np.random.default_rng(1)
 
     def run():
@@ -162,7 +169,8 @@ def bench_prefill(model_name, batch, prompt_len):
 def bench_mixed(model_name, batch, prompt_len, new_tokens):
     """Mixed SplitFuse: half the fleet decodes while half prefills — the
     host-driven step() loop, so the scheduler cost is IN the number."""
-    eng = _mk_engine(model_name, batch)
+    eng = _mk_engine(model_name, batch,
+                     expected_context=prompt_len + new_tokens)
     rng = np.random.default_rng(2)
     vocab = eng.model.cfg.vocab_size
 
