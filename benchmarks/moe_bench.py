@@ -129,7 +129,8 @@ def main():
     ep_row = None
     try:
         env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   XLA_FLAGS="--xla_force_host_platform_device_count=8")
+                   XLA_FLAGS=(os.environ.get("XLA_FLAGS", "") +
+                              " --xla_force_host_platform_device_count=8").strip())
         res = subprocess.run([sys.executable, os.path.abspath(__file__),
                               "--ep-virtual"], env=env, capture_output=True,
                              text=True, timeout=900)

@@ -28,11 +28,17 @@ def _bias_shapes(q):
     return (b, n, 1, 1, s), (b, 1, h, s, s)
 
 
-@functools.partial(jax.jit, static_argnames=("chunk",))
 def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
     """q/k/v: (B, N, S, H, D); biases: up to two of
     [(B, N, 1, 1, S) mask bias, (B, 1, H, S, S) pair bias].
     Returns (B, N, S, H, D) in q's dtype.
+
+    Dispatch: MXU-friendly shapes run the fused Pallas bias-flash forward
+    (``pallas/evoformer_flash.py`` — logits never hit HBM) with a
+    query-chunked recompute backward; other shapes take the chunked XLA
+    path end-to-end. The env kill switch is read at Python call time
+    (OUTSIDE the jitted internals) so toggling it mid-process works, like
+    every other Pallas dispatcher in this repo.
     """
     biases = [b for b in biases if b is not None]
     assert len(biases) <= 2, "at most two biases (mask, pair)"
@@ -46,7 +52,50 @@ def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
         else:
             raise ValueError(f"bias shape {b.shape} matches neither mask "
                              f"{s1} nor pair {s2}")
+    import os
+    from .pallas.evoformer_flash import evoformer_flash_supported
+    if (os.environ.get("DS_TPU_DISABLE_PALLAS", "0") != "1"
+            and evoformer_flash_supported(q.shape[2], q.shape[4])):
+        return _evo_attn_jit(q, k, v, bias1, bias2, chunk)
+    return _chunked_jit(q, k, v, bias1, bias2, chunk)
 
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5,))
+def _evo_attn(q, k, v, bias1, bias2, chunk):
+    from .pallas.evoformer_flash import evoformer_flash_fwd
+    d = q.shape[-1]
+    out = evoformer_flash_fwd(
+        jnp.moveaxis(q, 3, 2), jnp.moveaxis(k, 3, 2), jnp.moveaxis(v, 3, 2),
+        bias1, bias2, scale=d ** -0.5)
+    return jnp.moveaxis(out, 2, 3)
+
+
+def _evo_attn_fwd_rule(q, k, v, bias1, bias2, chunk):
+    return _evo_attn(q, k, v, bias1, bias2, chunk), (q, k, v, bias1, bias2)
+
+
+def _evo_attn_bwd_rule(chunk, residuals, g):
+    # recompute through the chunked XLA formulation: identical math, peak
+    # memory O(chunk * S) per (row, head); dBias1/dBias2 fall out of
+    # autodiff (the reference kernel's dB outputs)
+    q, k, v, bias1, bias2 = residuals
+    _, vjp = jax.vjp(
+        lambda q_, k_, v_, b1, b2: _chunked(q_, k_, v_, b1, b2, chunk),
+        q, k, v, bias1, bias2)
+    return vjp(g)
+
+
+_evo_attn.defvjp(_evo_attn_fwd_rule, _evo_attn_bwd_rule)
+
+_evo_attn_jit = jax.jit(_evo_attn, static_argnums=(5,))
+
+
+@functools.partial(jax.jit, static_argnames=("chunk",))
+def _chunked_jit(q, k, v, bias1, bias2, chunk):
+    return _chunked(q, k, v, bias1, bias2, chunk)
+
+
+def _chunked(q, k, v, bias1, bias2, chunk: int = 256):
     bdim, n, s, h, d = q.shape
     scale = d ** -0.5
     # (B, N, S, H, D) → (B, N, H, S, D)
