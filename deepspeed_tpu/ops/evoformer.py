@@ -52,12 +52,31 @@ def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
         else:
             raise ValueError(f"bias shape {b.shape} matches neither mask "
                              f"{s1} nor pair {s2}")
-    import os
     from .pallas.evoformer_flash import evoformer_flash_supported
-    if (os.environ.get("DS_TPU_DISABLE_PALLAS", "0") != "1"
-            and evoformer_flash_supported(q.shape[2], q.shape[4])):
-        return _evo_attn_jit(q, k, v, bias1, bias2, chunk)
+    if _use_pallas() and evoformer_flash_supported(q.shape[2], q.shape[4]):
+        try:
+            return _evo_attn_jit(q, k, v, bias1, bias2, chunk)
+        except Exception as e:
+            # same contract as the flash-attention dispatcher: a kernel
+            # failure downgrades to the XLA path LOUDLY, it does not crash
+            # the job
+            import logging
+            logging.getLogger("DeepSpeedTPU").warning(
+                "Pallas evoformer attention FAILED for shape %s (%s: %s); "
+                "falling back to the chunked XLA path. Set "
+                "DS_TPU_DISABLE_PALLAS=1 to silence.",
+                q.shape, type(e).__name__, e)
     return _chunked_jit(q, k, v, bias1, bias2, chunk)
+
+
+def _use_pallas() -> bool:
+    """Backend + env gate, read at Python call time (the repo's dispatcher
+    pattern, ops/attention.py): interpret-mode Pallas on CPU/GPU would be
+    orders of magnitude slower than the chunked XLA path."""
+    import os
+    if os.environ.get("DS_TPU_DISABLE_PALLAS", "0") == "1":
+        return False
+    return jax.default_backend() == "tpu"
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))

@@ -113,5 +113,10 @@ def evoformer_flash_fwd(q, k, v, bias1, bias2, *, scale,
 
 def evoformer_flash_supported(s, d, block_q=DEFAULT_BLOCK_Q,
                               block_k=DEFAULT_BLOCK_K) -> bool:
+    """Mosaic alignment, not just divisibility: S must be lane-aligned (the
+    bias blocks' last dim and the kv rows) — s % min(block, s) alone is
+    vacuously true for any s <= block and would admit 70-row blocks."""
+    if s % 128 != 0 or d not in (64, 128, 256):
+        return False
     bq, bk = min(block_q, s), min(block_k, s)
-    return s % bq == 0 and s % bk == 0 and d in (64, 128, 256)
+    return s % bq == 0 and s % bk == 0

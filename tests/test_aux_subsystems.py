@@ -730,3 +730,40 @@ def test_row_pruning_masks_trains_and_shrinks(mesh_8dp, rng):
     losses = [float(engine.train_batch({"input_ids": bids, "labels": bids}))
               for _ in range(3)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+def test_rejoin_membership_consensus_skewed_detection(tmp_path):
+    """The failure mode the consensus exists for: two survivors detect the
+    failure at DIFFERENT times. The early one publishes; the late one must
+    adopt the PUBLISHED epoch (not wait on a self-computed future epoch and
+    fall back to a divergent local view). Pure-filesystem test, no jax."""
+    import threading
+    import time as _t
+    from deepspeed_tpu.elasticity.rejoin import InProcessElasticWorker
+
+    run_dir = str(tmp_path)
+    w0 = InProcessElasticWorker(lambda w: None, "/unused", run_dir,
+                                heartbeat_timeout=2.0)
+    w1 = InProcessElasticWorker(lambda w: None, "/unused", run_dir,
+                                heartbeat_timeout=2.0)
+    w0.start(0, 3)
+    w1.start(1, 3)           # rank 2 never heartbeats → dead
+
+    res = {}
+    t0 = threading.Thread(target=lambda: res.setdefault("w0",
+                                                        w0._agree_alive()))
+    t0.start()               # rank 0 detects first, publishes membership.1
+    _t.sleep(1.5)            # rank 1 detects LATE, after the publish
+    res["w1"] = w1._agree_alive()
+    t0.join(10)
+    assert res["w0"] == res["w1"] == [0, 1]
+    assert w0._epoch == w1._epoch == 1       # both consumed the same epoch
+
+    # a second failure event later: epochs advance by scan, not blind count
+    with open(os.path.join(run_dir, "heartbeat.1"), "w") as f:
+        f.write("0")         # rank 1's heartbeat goes stale epoch-wise
+    os.utime(os.path.join(run_dir, "heartbeat.1"), (0, 0))
+    w0.rank, w0.world = 0, 2
+    alive2 = w0._agree_alive()
+    assert alive2 == [0]
+    assert w0._epoch == 2

@@ -126,6 +126,9 @@ def test_evoformer_flash_kernel(monkeypatch):
         return orig(*a, **k)
 
     monkeypatch.setattr(ef, "evoformer_flash_fwd", spy)
+    # the dispatcher gates on backend == tpu (interpret-mode Pallas is slow
+    # on CPU); force the path so the suite exercises the kernel
+    monkeypatch.setattr(evo, "_use_pallas", lambda: True)
     rng = np.random.default_rng(1)
     B, N, S, H, D = 1, 2, 128, 2, 64
     q = jnp.asarray(rng.normal(size=(B, N, S, H, D)), jnp.float32)
@@ -151,13 +154,22 @@ def test_evoformer_flash_kernel(monkeypatch):
     for a, b, nm in zip(g_flash, g_naive, ("dq", "dk", "dv", "db1", "db2")):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=3e-4,
                                    err_msg=nm)
-    # bias-free + mask-only variants route through the kernel too
+    # bias-free + mask-only variants route through the kernel too, BACKWARD
+    # included (the custom-VJP None-residual structure for absent biases)
     np.testing.assert_allclose(
         np.asarray(evo.DS4Sci_EvoformerAttention(q, k, v, [])),
         np.asarray(naive(q, k, v, 0.0, 0.0)), atol=2e-5)
+    g0 = jax.grad(lambda q_: jnp.sum(
+        evo.DS4Sci_EvoformerAttention(q_, k, v, []) ** 2))(q)
+    g0r = jax.grad(lambda q_: jnp.sum(naive(q_, k, v, 0.0, 0.0) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g0r), atol=3e-4)
     np.testing.assert_allclose(
         np.asarray(evo.DS4Sci_EvoformerAttention(q, k, v, [b1])),
         np.asarray(naive(q, k, v, b1, 0.0)), atol=2e-5)
+    g1 = jax.grad(lambda b: jnp.sum(
+        evo.DS4Sci_EvoformerAttention(q, k, v, [b]) ** 2))(b1)
+    g1r = jax.grad(lambda b: jnp.sum(naive(q, k, v, b, 0.0) ** 2))(b1)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g1r), atol=3e-4)
 
 
 def test_flash_alibi_matches_reference():
