@@ -21,6 +21,8 @@ from typing import Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from .attention import _use_pallas
+
 
 def _bias_shapes(q):
     b, n, s = q.shape[0], q.shape[1], q.shape[2]
@@ -53,13 +55,16 @@ def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
             raise ValueError(f"bias shape {b.shape} matches neither mask "
                              f"{s1} nor pair {s2}")
     from .pallas.evoformer_flash import evoformer_flash_supported
-    if _use_pallas() and evoformer_flash_supported(q.shape[2], q.shape[4]):
+    if (_use_pallas() and evoformer_flash_supported(q.shape[2], q.shape[4])
+            and q.shape not in _EVO_FALLBACK_WARNED):
         try:
             return _evo_attn_jit(q, k, v, bias1, bias2, chunk)
         except Exception as e:
             # same contract as the flash-attention dispatcher: a kernel
-            # failure downgrades to the XLA path LOUDLY, it does not crash
-            # the job
+            # failure downgrades to the XLA path LOUDLY, once per shape
+            # (the shape also skips straight to the XLA path afterwards —
+            # no per-step recompile attempts)
+            _EVO_FALLBACK_WARNED.add(q.shape)
             import logging
             logging.getLogger("DeepSpeedTPU").warning(
                 "Pallas evoformer attention FAILED for shape %s (%s: %s); "
@@ -69,14 +74,7 @@ def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
     return _chunked_jit(q, k, v, bias1, bias2, chunk)
 
 
-def _use_pallas() -> bool:
-    """Backend + env gate, read at Python call time (the repo's dispatcher
-    pattern, ops/attention.py): interpret-mode Pallas on CPU/GPU would be
-    orders of magnitude slower than the chunked XLA path."""
-    import os
-    if os.environ.get("DS_TPU_DISABLE_PALLAS", "0") == "1":
-        return False
-    return jax.default_backend() == "tpu"
+_EVO_FALLBACK_WARNED = set()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5,))

@@ -767,3 +767,47 @@ def test_rejoin_membership_consensus_skewed_detection(tmp_path):
     alive2 = w0._agree_alive()
     assert alive2 == [0]
     assert w0._epoch == 2
+
+
+def test_launcher_local_end_to_end(tmp_path):
+    """REAL execution of the localhost launch path (not a command-string
+    test): dstpu main() → launch.py spawner → 2 worker OS processes, each
+    seeing its RANK/LOCAL_RANK/WORLD_SIZE/MASTER_* env (reference
+    launcher/launch.py:133 semantics). Also: a failing worker propagates a
+    non-zero exit through the whole chain."""
+    import textwrap
+    from deepspeed_tpu.launcher.runner import main
+
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import json, os, sys
+        out = os.path.join(os.environ["OUT_DIR"],
+                           f"rank{os.environ['RANK']}.json")
+        with open(out, "w") as f:
+            json.dump({k: os.environ.get(k) for k in
+                       ("RANK", "LOCAL_RANK", "WORLD_SIZE", "NODE_RANK",
+                        "MASTER_ADDR", "MASTER_PORT")}, f)
+        sys.exit(int(sys.argv[1]) if len(sys.argv) > 1 else 0)
+    """))
+    os.environ["OUT_DIR"] = str(tmp_path)
+    try:
+        # EXPORT_ENVS must carry OUT_DIR through the shell hop
+        from deepspeed_tpu.launcher import runner as rmod
+        rmod.EXPORT_ENVS.append("OUT_DIR")
+        rc = main(["--num_gpus", "2", str(script)])
+        assert rc == 0
+        import json
+        got = {}
+        for r in (0, 1):
+            with open(tmp_path / f"rank{r}.json") as f:
+                got[r] = json.load(f)
+        assert got[0]["RANK"] == "0" and got[1]["RANK"] == "1"
+        assert got[0]["LOCAL_RANK"] == "0" and got[1]["LOCAL_RANK"] == "1"
+        assert got[0]["WORLD_SIZE"] == got[1]["WORLD_SIZE"] == "2"
+        assert got[0]["MASTER_ADDR"] and got[0]["MASTER_PORT"]
+        # failure propagation: worker exit 3 → launcher returns non-zero
+        rc_bad = main(["--num_gpus", "2", str(script), "3"])
+        assert rc_bad != 0
+    finally:
+        rmod.EXPORT_ENVS.remove("OUT_DIR")
+        os.environ.pop("OUT_DIR", None)
