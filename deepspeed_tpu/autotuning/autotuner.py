@@ -23,7 +23,8 @@ class Autotuner:
     def __init__(self, model, base_config: Dict[str, Any], seq_len: int = 512,
                  micro_batch_candidates=DEFAULT_MICRO_BATCHES,
                  zero_stage_candidates=(0, 1, 2, 3), steps_per_trial: int = 3,
-                 strategy: str = "heuristic", max_trials: Optional[int] = None):
+                 strategy: str = "heuristic", max_trials: Optional[int] = None,
+                 remat_candidates=("none", "dots")):
         self.model = model
         self.base_config = dict(base_config)
         self.seq_len = seq_len
@@ -32,6 +33,12 @@ class Autotuner:
         self.steps_per_trial = steps_per_trial
         self.strategy = strategy          # "heuristic" | tuner.TUNERS names
         self.max_trials = max_trials
+        # remat joins the search space: on HBM-bound parts saving only
+        # matmul outputs ("dots") BEATS saving everything (round-5 measured
+        # +7% on v5e — saved-activation traffic, not recompute FLOPs, was
+        # the binding constraint), so it is a throughput knob, not only a
+        # memory knob
+        self.remat_candidates = list(remat_candidates)
         self.results: List[Dict[str, Any]] = []
 
     def model_info(self) -> Dict[str, Any]:
@@ -41,7 +48,8 @@ class Autotuner:
                 "fp32_mem_gb": 4 * n / 2 ** 30,
                 "adam_state_gb": 8 * n / 2 ** 30}
 
-    def _trial(self, zero_stage: int, micro_batch: int) -> Optional[float]:
+    def _trial(self, zero_stage: int, micro_batch: int,
+               remat: str = "none") -> Optional[float]:
         import jax
         import deepspeed_tpu as ds
         from ..utils import groups
@@ -55,8 +63,18 @@ class Autotuner:
             "gradient_accumulation_steps": 1,
             "train_batch_size": micro_batch * dp,
             "zero_optimization": {"stage": zero_stage},
+            "activation_checkpointing": {"policy": remat},
             "steps_per_print": 10 ** 9,
         })
+        cfg_owner = self.model
+        try:
+            from ..models.transformer import CausalLM
+            if not isinstance(cfg_owner, CausalLM) and isinstance(
+                    getattr(cfg_owner, "student", None), CausalLM):
+                cfg_owner = cfg_owner.student   # the object the engine mutates
+        except Exception:
+            pass
+        prev_remat = getattr(getattr(cfg_owner, "cfg", None), "remat", None)
         try:
             engine, _, _, _ = ds.initialize(model=self.model, config=cfg)
             rng = np.random.default_rng(0)
@@ -75,9 +93,15 @@ class Autotuner:
             dt = (time.perf_counter() - t0) / self.steps_per_trial
             return cfg["train_batch_size"] * self.seq_len / dt
         except Exception as e:
-            logger.warning(f"trial zero={zero_stage} mb={micro_batch} failed: "
-                           f"{str(e)[:120]}")
+            logger.warning(f"trial zero={zero_stage} mb={micro_batch} "
+                           f"remat={remat} failed: {str(e)[:120]}")
             return None
+        finally:
+            # the engine writes the policy into the model cfg; restore ON THE
+            # SAME OBJECT it mutates (setattr on a delegating wrapper would
+            # create a shadow attribute and leak the policy)
+            if prev_remat is not None and hasattr(cfg_owner, "cfg"):
+                cfg_owner.cfg = cfg_owner.cfg.replace(remat=prev_remat)
 
     def tune(self, fast: bool = True) -> Dict[str, Any]:
         """Run the search; returns the best config patch (reference tune:404).
@@ -93,11 +117,13 @@ class Autotuner:
         stages = [self.stage_candidates[0]] if fast and len(self.stage_candidates) > 1 \
             else self.stage_candidates
         best = None
+        base_remat = self.remat_candidates[0] if self.remat_candidates else "none"
         for stage in stages:
             prev = 0.0
             for mb in self.mb_candidates:
-                tput = self._trial(stage, mb)
+                tput = self._trial(stage, mb, base_remat)
                 self.results.append({"zero_stage": stage, "micro_batch": mb,
+                                     "remat": base_remat,
                                      "tokens_per_sec": tput})
                 if tput is None:
                     break            # OOM / failure: larger batches won't fit
@@ -109,24 +135,39 @@ class Autotuner:
                 prev = tput
         if best is None:
             raise RuntimeError("autotuning: no trial succeeded")
+        # remat post-pass at the winning (stage, mb): one extra trial per
+        # alternative policy — the cheap form of the full product search
+        best["remat"] = base_remat
+        for remat in self.remat_candidates[1:]:
+            tput = self._trial(best["zero_stage"], best["micro_batch"], remat)
+            self.results.append({"zero_stage": best["zero_stage"],
+                                 "micro_batch": best["micro_batch"],
+                                 "remat": remat, "tokens_per_sec": tput})
+            if tput is not None and tput > best["tokens_per_sec"]:
+                best.update(tokens_per_sec=tput, remat=remat)
         logger.info(f"autotuning best: {best}")
         return {
             "train_micro_batch_size_per_gpu": best["micro_batch"],
             "zero_optimization": {"stage": best["zero_stage"]},
+            "activation_checkpointing": {"policy": best["remat"]},
             "autotuning_results": self.results,
         }
 
     def _tune_with_strategy(self) -> Dict[str, Any]:
         from .tuner import build_tuner
-        experiments = [{"zero_stage": s, "micro_batch": mb}
-                       for s in self.stage_candidates for mb in self.mb_candidates]
+        remats = self.remat_candidates or ["none"]
+        experiments = [{"zero_stage": s, "micro_batch": mb, "remat": r}
+                       for s in self.stage_candidates
+                       for mb in self.mb_candidates
+                       for r in remats]
         tuner = build_tuner(self.strategy, experiments)
         budget = self.max_trials or len(experiments)
         for _ in range(budget):
             if not tuner.has_next():
                 break
             exp = tuner.next_trial()
-            tput = self._trial(exp["zero_stage"], exp["micro_batch"])
+            tput = self._trial(exp["zero_stage"], exp["micro_batch"],
+                               exp.get("remat", "none"))
             tuner.update(exp, tput)
             self.results.append({**exp, "tokens_per_sec": tput})
         top = tuner.best()
@@ -138,5 +179,6 @@ class Autotuner:
         return {
             "train_micro_batch_size_per_gpu": best_exp["micro_batch"],
             "zero_optimization": {"stage": best_exp["zero_stage"]},
+            "activation_checkpointing": {"policy": best_exp.get("remat", "none")},
             "autotuning_results": self.results,
         }

@@ -73,14 +73,23 @@ def ds_to_universal(engine, output_dir: str):
     (reference ds_to_universal main:469). Multi-process: every rank joins
     the consolidation allgather; rank 0 writes the files."""
     os.makedirs(output_dir, exist_ok=True)
-    engine._swap_in_opt_state()
-    opt_tree = (engine._host_optimizer.state_dict()
-                if getattr(engine, "_host_optimizer", None) is not None
-                else engine.opt_state)
-    state = {
-        "module": engine.module_state_dict(),
-        "optimizer": _fetch_replicated(engine, opt_tree),
-    }
+    if getattr(engine, "_infinity", None) is not None:
+        # layer-streaming engines: per-parameter host trees straight from
+        # the runner (group-layout-free — restorable under a different
+        # stream_group_layers). No collectives involved, so non-writing
+        # ranks skip the full host/NVMe state sweep entirely.
+        if jax.process_index() != 0:
+            return None
+        state = engine._infinity.universal_state_dict()
+    else:
+        engine._swap_in_opt_state()
+        opt_tree = (engine._host_optimizer.state_dict()
+                    if getattr(engine, "_host_optimizer", None) is not None
+                    else engine.opt_state)
+        state = {
+            "module": engine.module_state_dict(),
+            "optimizer": _fetch_replicated(engine, opt_tree),
+        }
     if getattr(engine, "_twinflow", None) is not None:
         # Twin-Flow keeps the device half of the optimizer state outside
         # _host_optimizer; without it a resume would run the device update
@@ -126,6 +135,15 @@ def load_universal_checkpoint(engine, load_dir: str, load_optimizer_states: bool
                else np.load(os.path.join(load_dir, entry["file"])))
         sections.setdefault(entry["section"], {})[entry["path"]] = arr
     module = _unflatten_from_paths(sections["module"])
+    if getattr(engine, "_infinity", None) is not None:
+        opt = (_unflatten_from_paths(sections["optimizer"])
+               if load_optimizer_states and sections["optimizer"] else None)
+        engine._infinity.load_universal_state_dict(module, opt)
+        meta = index.get("meta", {})
+        engine.global_steps = int(meta.get("global_steps", 0))
+        engine.global_samples = int(meta.get("global_samples", 0))
+        engine.micro_steps = int(meta.get("micro_steps", 0))
+        return meta
     engine.module_params = jax.device_put(module, engine.param_shardings)
     if load_optimizer_states and sections["optimizer"]:
         opt = _unflatten_from_paths(sections["optimizer"])

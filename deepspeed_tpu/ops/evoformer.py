@@ -55,8 +55,9 @@ def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
             raise ValueError(f"bias shape {b.shape} matches neither mask "
                              f"{s1} nor pair {s2}")
     from .pallas.evoformer_flash import evoformer_flash_supported
+    fb_key = (q.shape, str(q.dtype))
     if (_use_pallas() and evoformer_flash_supported(q.shape[2], q.shape[4])
-            and q.shape not in _EVO_FALLBACK_WARNED):
+            and fb_key not in _EVO_FALLBACK_WARNED):
         try:
             return _evo_attn_jit(q, k, v, bias1, bias2, chunk)
         except Exception as e:
@@ -64,7 +65,7 @@ def DS4Sci_EvoformerAttention(q, k, v, biases: Sequence = (), chunk: int = 256):
             # failure downgrades to the XLA path LOUDLY, once per shape
             # (the shape also skips straight to the XLA path afterwards —
             # no per-step recompile attempts)
-            _EVO_FALLBACK_WARNED.add(q.shape)
+            _EVO_FALLBACK_WARNED.add(fb_key)
             import logging
             logging.getLogger("DeepSpeedTPU").warning(
                 "Pallas evoformer attention FAILED for shape %s (%s: %s); "

@@ -614,29 +614,96 @@ class InfinityRunner:
         """Full (host) fp32 param tree — the zero_to_fp32 analog. The layer
         tree follows the model's layout: one stacked tree when homogeneous,
         the grouped {"g0", ...} layout for heterogeneous stacks."""
-        per_layer = {}   # global layer index -> (treedef, leaf rows)
+        return self._gathered(("p",))["p"]
+
+    def _gathered(self, kinds):
+        """Full host fp32 trees of the requested state kinds (subset of
+        ("p", "m", "v")) in the MODEL's param layout — per-parameter and
+        group-layout-free, so the universal checkpoint written from it
+        restores under a different stream_group_layers. One sweep over the
+        groups serves every kind (one NVMe fetch per group)."""
+        per_layer = {k: {} for k in kinds}   # kind -> idx -> (treedef, leaves)
         for gi in range(self.n_groups):
             st = self.store.fetch(gi)
-            if self._group_mixed[gi]:
-                lp_tuple = jax.tree.unflatten(self._group_treedefs[gi], st["p"])
-                for row, lp in enumerate(lp_tuple):
-                    leaves, td = jax.tree.flatten(lp)
-                    per_layer[gi * self.group_layers + row] = (td, leaves)
-            else:
-                for row in range(self.group_layers):
-                    per_layer[gi * self.group_layers + row] = (
-                        self._group_treedefs[gi], [a[row] for a in st["p"]])
+            for kind in kinds:
+                if self._group_mixed[gi]:
+                    lp_tuple = jax.tree.unflatten(self._group_treedefs[gi],
+                                                  st[kind])
+                    for row, lp in enumerate(lp_tuple):
+                        leaves, td = jax.tree.flatten(lp)
+                        per_layer[kind][gi * self.group_layers + row] = (td, leaves)
+                else:
+                    for row in range(self.group_layers):
+                        per_layer[kind][gi * self.group_layers + row] = (
+                            self._group_treedefs[gi], [a[row] for a in st[kind]])
             self.store.evict_to_budget(keep=[gi])
 
-        def stack(idxs):
-            td = per_layer[idxs[0]][0]
-            leaves = [np.stack([per_layer[i][1][j] for i in idxs])
-                      for j in range(len(per_layer[idxs[0]][1]))]
+        def stack(kind, idxs):
+            pl = per_layer[kind]
+            td = pl[idxs[0]][0]
+            leaves = [np.stack([pl[i][1][j] for i in idxs])
+                      for j in range(len(pl[idxs[0]][1]))]
             return jax.tree.unflatten(td, leaves)
 
-        if self.model._groups is None:
-            layers = stack(list(range(self.cfg.num_layers)))
-        else:
-            layers = {f"g{k}": stack(list(idxs))
-                      for k, (_, idxs) in enumerate(self.model._groups)}
-        return {**self.persist["p"], "layers": layers}
+        out = {}
+        for kind in kinds:
+            if self.model._groups is None:
+                layers = stack(kind, list(range(self.cfg.num_layers)))
+            else:
+                layers = {f"g{k}": stack(kind, list(idxs))
+                          for k, (_, idxs) in enumerate(self.model._groups)}
+            out[kind] = {**self.persist[kind], "layers": layers}
+        return out
+
+    # ---------------- universal (topology/group-free) checkpoint --------
+
+    def universal_state_dict(self):
+        """Per-parameter host trees: the module params plus Adam moments in
+        the MODEL layout (reference ds_to_universal's atomic-per-parameter
+        format) — restorable under a different stream_group_layers (and, at
+        the engine level, a different mesh). All three kinds are pulled in
+        ONE sweep over the groups (one NVMe fetch per group, not three)."""
+        full = self._gathered(("p", "m", "v"))
+        return {"module": full["p"],
+                "optimizer": {"m": full["m"], "v": full["v"],
+                              "step": np.asarray(self.step_num, np.int32)}}
+
+    def load_universal_state_dict(self, module, opt=None):
+        """Inverse of ``universal_state_dict``: split per-parameter trees
+        back into THIS runner's group layout. ``opt=None`` restores params
+        only (moments keep their current values)."""
+        kinds = [("p", module)]
+        if opt is not None:
+            kinds += [("m", opt["m"]), ("v", opt["v"])]
+            self.step_num = int(np.asarray(opt["step"]))
+
+        def layer_leaves(layers, idx):
+            if self.model._groups is None:
+                return jax.tree.leaves(jax.tree.map(lambda x: x[idx], layers))
+            for k, (_, idxs) in enumerate(self.model._groups):
+                if idx in idxs:
+                    pos = list(idxs).index(idx)
+                    return jax.tree.leaves(jax.tree.map(
+                        lambda x: x[pos], layers[f"g{k}"]))
+            raise KeyError(f"layer {idx} not found in grouped layout")
+
+        for kind, full in kinds:
+            self.persist[kind] = jax.tree.map(
+                lambda x: np.ascontiguousarray(np.asarray(x, np.float32)),
+                {k: v for k, v in full.items() if k != "layers"})
+        # one sweep over groups, installing every kind per fetch
+        for gi in range(self.n_groups):
+            st = self.store.fetch(gi)
+            for kind, full in kinds:
+                rows = [layer_leaves(full["layers"], gi * self.group_layers + r)
+                        for r in range(self.group_layers)]
+                if self._group_mixed[gi]:
+                    # tuple-of-trees layout: per-layer leaf lists concatenated
+                    st[kind] = [np.ascontiguousarray(np.asarray(a, np.float32))
+                                for row in rows for a in row]
+                else:
+                    st[kind] = [np.ascontiguousarray(np.stack(
+                        [r[j] for r in rows]).astype(np.float32))
+                        for j in range(len(rows[0]))]
+            self.store.put(gi, st)
+            self.store.evict_to_budget(keep=[gi])
