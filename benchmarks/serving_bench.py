@@ -226,6 +226,120 @@ def bench_mixed(model_name, batch, prompt_len, new_tokens):
     }
 
 
+def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
+                        n_arrivals=32, rate_hz=40.0, frame_steps=8):
+    """Dynamic arrivals (Poisson, fixed seed): the frame-based serve() loop
+    vs the host-driven step() loop on the SAME arrival schedule. This is the
+    workload the frame loop exists for — mixed-splitfuse showed the host
+    step loop at ~1/9.5 of the statically-compiled path; here both
+    contenders ingest mid-stream arrivals, so the gap this tracks is pure
+    host-scheduling overhead, not admission capability."""
+    from deepspeed_tpu.inference.v2.ragged_manager import DeviceSlotTable
+    eng = _mk_engine(model_name, batch,
+                     expected_context=prompt_len + new_tokens)
+    rng = np.random.default_rng(3)
+    vocab = eng.model.cfg.vocab_size
+    prompts = [rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+               for _ in range(n_arrivals)]
+    gaps = rng.exponential(1.0 / rate_hz, n_arrivals)
+    gaps[0] = 0.0
+    offsets = np.cumsum(gaps)
+
+    def run_frames():
+        """serve() with wall-clock Poisson arrivals; returns (produced, dt,
+        device_time) — dt - device_time is the host boundary cost."""
+        t_start = time.perf_counter()
+
+        def arrivals():
+            nxt = 0
+            while nxt < n_arrivals:
+                now = time.perf_counter() - t_start
+                due = []
+                while nxt < n_arrivals and offsets[nxt] <= now:
+                    due.append((nxt, prompts[nxt]))
+                    nxt += 1
+                yield due
+
+        dev_box = [0.0]
+        orig_run = DeviceSlotTable.run_frame
+
+        def timed_run(self, *a, **kw):
+            s = time.perf_counter()
+            out = orig_run(self, *a, **kw)
+            dev_box[0] += time.perf_counter() - s
+            return out
+
+        DeviceSlotTable.run_frame = timed_run
+        produced = 0
+        try:
+            t0 = time.perf_counter()
+            for _uid, toks in eng.serve(arrivals(), max_new_tokens=new_tokens,
+                                        frame_steps=frame_steps):
+                produced += len(toks)
+            dt = time.perf_counter() - t0
+        finally:
+            DeviceSlotTable.run_frame = orig_run
+        return produced, dt, dev_box[0]
+
+    def run_host_steps():
+        """The pre-frame-loop contender: put()+step() per token, same
+        schedule, same admission control as serve() (full prompt+budget
+        block reservation, FIFO deferral when the pool can't hold it —
+        step() grows KV lazily, so without the reservation an over-admitted
+        batch dies mid-decode)."""
+        live, counts, produced = set(), {}, 0
+        queue, nxt = [], 0
+        final = prompt_len + new_tokens + 1
+
+        def can_admit():
+            growth = sum(eng.kv.blocks_for(final) -
+                         len(eng.state.seqs[u].blocks) for u in live)
+            return (len(live) < batch and
+                    eng.kv.free_blocks - growth >= eng.kv.blocks_for(final))
+
+        t0 = time.perf_counter()
+        while nxt < n_arrivals or queue or live:
+            now = time.perf_counter() - t0
+            while nxt < n_arrivals and offsets[nxt] <= now:
+                queue.append(nxt)
+                nxt += 1
+            while queue and can_admit():
+                u = queue.pop(0)
+                eng.put([u], [prompts[u]])
+                counts[u] = 0
+                live.add(u)
+            if not live:
+                continue
+            out = eng.step()
+            for u, _t in out.items():
+                counts[u] += 1
+                if counts[u] >= new_tokens:
+                    eng.state.seqs[u].done = True
+                    produced += counts[u]
+                    eng.flush([u])
+                    live.discard(u)
+        return produced, time.perf_counter() - t0
+
+    run_frames()                                      # compile both widths
+    f_produced, f_dt, f_dev = run_frames()
+    run_host_steps()                                  # compile
+    h_produced, h_dt = run_host_steps()
+    return {
+        "workload": "mixed-splitfuse-dynamic", "batch": batch,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "arrivals": n_arrivals, "arrival_rate_hz": rate_hz,
+        "frame_steps": frame_steps,
+        "frame_tok_per_sec": round(f_produced / f_dt, 1),
+        "sched_overhead_pct": round(100 * (f_dt - f_dev) / f_dt, 2),
+        "host_step_tok_per_sec": round(h_produced / h_dt, 1),
+        "frame_speedup": round((f_produced / f_dt) / (h_produced / h_dt), 2),
+        "note": "same Poisson schedule for both loops; frame_tok_per_sec is "
+                "the device-resident frame loop (host touches the loop only "
+                "at frame boundaries), host_step_tok_per_sec the per-step "
+                "host scheduler this PR retires for dynamic traffic",
+    }
+
+
 def bench_mixed_compiled(model_name, batch, prompt_lens, new_tokens):
     """Mixed SplitFuse via the COMPILED loop (generate_compiled): staggered
     prompt lengths make early finishers decode inside wide prefill steps —
@@ -390,6 +504,7 @@ def main():
         prefill_cfgs = [(8, long_prompt)]
         mixed = (16, 256, 64)
         mixed_compiled = (16, (256, 64), 64)
+        mixed_dynamic = (16, 256, 64, 32)      # last field: n_arrivals
         delta = (32, 512, 128)
         # near-full contexts (832 + 128 + 1 lookahead slot = 961 <= 1024,
         # exactly 8 pages/seq; 896 would need a 9th page past max_seq_len)
@@ -402,6 +517,7 @@ def main():
         prefill_cfgs = [(4, long_prompt)]
         mixed = (4, 32, 8)
         mixed_compiled = (4, (32, 16), 8)
+        mixed_dynamic = (4, 32, 8, 8)
         delta = (4, 32, 16)
         delta_long = None
         medium_decode = None
@@ -428,6 +544,9 @@ def main():
     guarded("mixed-splitfuse", bench_mixed, model, *mixed)
     guarded("mixed-splitfuse-compiled", bench_mixed_compiled, model,
             *mixed_compiled)
+    b, p, n, arr = mixed_dynamic
+    guarded("mixed-splitfuse-dynamic", bench_mixed_dynamic, model, b, p, n,
+            n_arrivals=arr)
     guarded("kernel-delta", bench_kernel_delta, model, *delta)
     if delta_long is not None:
         guarded("kernel-delta", bench_kernel_delta, model, *delta_long)

@@ -5,6 +5,18 @@ import jax
 import jax.numpy as jnp
 
 
+def sample_logits_per_row(logits, rng, temps):
+    """Row-wise sampling for the device-resident serving frame: ``temps``
+    (B,) float32 rides in the frame carry, so rows with different sampling
+    settings share one batch. Rows with temp <= 0 take argmax (bit-identical
+    to the greedy host path); the rest sample at their own temperature.
+    logits: (B, V) → token ids (B,) int32."""
+    greedy_toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.maximum(temps, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy_toks, sampled)
+
+
 def sample_logits(logits, rng, *, temperature: float = 1.0, top_k: int = 0,
                   top_p: float = 1.0, greedy: bool = False):
     """logits: (B, V) → token ids (B,) int32."""

@@ -9,11 +9,15 @@ near the token budget so latency stays flat while the MXU stays fed
 
 Serving surface (MII-compatible): ``put(batch_uids, batch_tokens)``,
 ``scheduled step()``, ``query``, ``can_schedule``, ``flush``; plus a
-convenience ``generate`` driving the loop to completion.
+convenience ``generate`` driving the loop to completion and the
+frame-based ``serve(arrivals)`` loop for continuous batching with dynamic
+arrivals at compiled-loop speed (host touches the device only at K-step
+frame boundaries).
 """
 
+import collections
 import dataclasses
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,7 +29,7 @@ from ..config import DeepSpeedInferenceConfig
 from ..sampling import sample_logits
 from .kv_cache import BlockedKVCache
 from .model_runner import PagedModelRunner
-from .ragged_manager import DSStateManager
+from .ragged_manager import DeviceSlotTable, DSStateManager
 
 
 @dataclasses.dataclass
@@ -48,6 +52,10 @@ class RaggedInferenceEngineConfig:
     prefill_chunk_size: int = 128            # Dynamic SplitFuse chunk
     max_tokens_per_step: int = 512           # token budget per step
     max_tracked_sequences: int = 2048
+    # serve(): steps per device-resident frame. Larger frames amortize the
+    # host boundary further but delay admission of new arrivals by up to
+    # frame_steps decode steps (see README "frame loop" tradeoff).
+    frame_steps: int = 8
     dtype: str = "bfloat16"
 
 
@@ -81,7 +89,7 @@ class InferenceEngineV2:
                                  num_blocks=num_blocks, block_size=bs,
                                  dtype=cfg.act_dtype)
         # block 0 is the trash block for padded writes — never allocate it
-        self.kv.allocator.allocate(1)
+        self.kv.reserve_trash_block()
         self.state = DSStateManager(self.kv, c.max_tracked_sequences)
         self.runner = PagedModelRunner(self.model, bs, max_blocks_per_seq)
         self.max_blocks_per_seq = max_blocks_per_seq
@@ -155,19 +163,28 @@ class InferenceEngineV2:
 
     def _run_batch(self, seqs, chunk: int, take: Dict[int, int],
                    greedy=True, temperature=0.0):
-        """Run one padded (B, chunk) forward over paged KV for ``seqs``."""
+        """Run one padded (B, chunk) forward over paged KV for ``seqs``.
+
+        The batch dimension is padded to the next power of two (mirroring
+        ``_block_tables``'s width bucketing): the per-chunk jit cache keys
+        only on chunk width, so without padding every distinct live batch
+        size B compiles a fresh program. Pad rows carry positions -1 — the
+        pager routes their writes to the trash block and the attention mask
+        kills their reads — and their sampled tokens are never consumed."""
         b = len(seqs)
-        ids = np.zeros((b, chunk), np.int32)
-        positions = np.full((b, chunk), -1, np.int32)
-        valid = np.zeros((b,), np.int32)
-        tables = np.zeros((b, self.max_blocks_per_seq), np.int32)
+        bp = BlockedKVCache.bucket_width(
+            b, max(b, self._config.max_ragged_batch_size))
+        ids = np.zeros((bp, chunk), np.int32)
+        positions = np.full((bp, chunk), -1, np.int32)
+        valid = np.zeros((bp,), np.int32)
+        tables = np.zeros((bp, self.max_blocks_per_seq), np.int32)
         for i, s in enumerate(seqs):
             n = take[s.uid]
             toks = s.pending[:n] if s.in_prefill else s.generated[-1:]
             ids[i, :n] = toks
             positions[i, :n] = s.seen_tokens + np.arange(n)
             valid[i] = n
-            tables[i, :len(s.blocks)] = s.blocks
+            tables[i] = self.state.block_table(s, self.max_blocks_per_seq)
 
         logits, self.kv.k, self.kv.v = self.runner.run(
             chunk, self.params, jnp.asarray(ids), jnp.asarray(positions),
@@ -230,9 +247,26 @@ class InferenceEngineV2:
         remaining = max_new_tokens - 1
         if remaining > 0:
             seqs = [self.state.seqs[u] for u in uids]
-            for s in seqs:
-                if not self.state.ensure_capacity(s, s.seen_tokens + remaining + 1):
-                    raise RuntimeError("KV pool exhausted for compiled decode loop")
+            if not all(self.state.ensure_capacity(s, s.seen_tokens + remaining + 1)
+                       for s in seqs):
+                # The pool can't cover the whole compiled decode budget up
+                # front. Degrade to the chunked step() loop, which allocates
+                # per step and stops cleanly when the pool truly runs dry —
+                # a smaller/slower answer beats failing the batch. The
+                # all() above short-circuited, leaving earlier rows holding
+                # their full budget; release everything beyond what their
+                # next decode write needs so the fallback shares the pool.
+                for s in seqs:
+                    keep = self.kv.blocks_for(s.seen_tokens + 1)
+                    if len(s.blocks) > keep:
+                        self.kv.allocator.free(s.blocks[keep:])
+                        del s.blocks[keep:]
+                logger.warning(
+                    "KV pool cannot cover the compiled decode budget "
+                    f"({self.kv.free_blocks} blocks free); degrading to the "
+                    "chunked step() loop for the remainder")
+                self._stepwise_decode(seqs, max_new_tokens, temperature)
+                return self._finalize(uids, max_new_tokens, eos_token_id)
             last_ids = np.asarray([s.generated[-1] for s in seqs], np.int32)
             lens = np.asarray([s.seen_tokens for s in seqs], np.int32)
             tables = self._block_tables(seqs)
@@ -247,6 +281,30 @@ class InferenceEngineV2:
                 s.generated.extend(int(t) for t in toks[:, i])
                 s.seen_tokens += remaining
                 s.done = True
+        return self._finalize(uids, max_new_tokens, eos_token_id)
+
+    def _stepwise_decode(self, seqs, max_new_tokens: int, temperature: float):
+        """Drive step() until every sequence reaches ``max_new_tokens`` or
+        the KV pool stops yielding progress (partial generations returned).
+        Finished rows release their KV blocks immediately — in this path the
+        pool is by definition too small, so a done row's pages are exactly
+        what lets a straggler keep decoding."""
+        while True:
+            for s in seqs:
+                if len(s.generated) >= max_new_tokens and not s.done:
+                    s.done = True
+                    if s.blocks:
+                        self.kv.allocator.free(s.blocks)
+                        s.blocks = []
+            if all(s.done for s in seqs):
+                return
+            if not self.step(temperature=temperature):
+                logger.warning(
+                    "KV pool exhausted mid-decode; returning partial "
+                    f"generations ({self.kv.free_blocks} blocks free)")
+                return
+
+    def _finalize(self, uids, max_new_tokens: int, eos_token_id):
         outs = []
         for u in uids:
             g = self.state.seqs[u].generated[:max_new_tokens]
@@ -262,14 +320,8 @@ class InferenceEngineV2:
         scales with table width, so a 1k-ctx model serving 192-token
         requests pays for 4 pages, not 16."""
         need = max(len(s.blocks) for s in seqs)
-        mb = 1
-        while mb < min(need, self.max_blocks_per_seq):
-            mb *= 2
-        mb = min(mb, self.max_blocks_per_seq)
-        tables = np.zeros((len(seqs), mb), np.int32)
-        for i, s in enumerate(seqs):
-            tables[i, :len(s.blocks)] = s.blocks
-        return tables
+        mb = BlockedKVCache.bucket_width(need, self.max_blocks_per_seq)
+        return np.stack([self.state.block_table(s, mb) for s in seqs])
 
     def generate_compiled(self, prompts: List[np.ndarray],
                           max_new_tokens: int = 32, temperature: float = 0.0,
@@ -319,6 +371,171 @@ class InferenceEngineV2:
             outs.append(np.asarray(g))
         self.flush(uids)
         return outs
+
+    # ------------------------------------------------------------------
+    # frame-based persistent serving loop (dynamic arrivals)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _norm_arrival(item, max_new_tokens, temperature, eos_token_id):
+        """(uid, tokens[, max_new_tokens[, temperature[, eos_id]]]) with
+        serve()-level defaults filled in; None in any optional field means
+        "use the default" (pass eos_id=-1 to disable EOS for one row when a
+        serve()-level eos_token_id is set)."""
+        uid, toks = item[0], item[1]
+        limit = item[2] if len(item) > 2 and item[2] is not None else max_new_tokens
+        temp = item[3] if len(item) > 3 and item[3] is not None else temperature
+        eos = item[4] if len(item) > 4 and item[4] is not None else eos_token_id
+        return uid, np.asarray(toks, np.int32).reshape(-1), int(limit), \
+            float(temp), eos
+
+    def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
+              temperature: float = 0.0, eos_token_id: Optional[int] = None,
+              frame_steps: Optional[int] = None,
+              frame_slots: Optional[int] = None):
+        """Continuous batching with dynamic arrivals at compiled-loop speed.
+
+        Generator: yields ``(uid, generated_tokens)`` as sequences finish.
+
+        ``arrivals`` is an iterator polled once per frame boundary; each
+        ``next()`` returns the sequences that arrived since the last poll
+        (possibly an empty list) as ``(uid, prompt_tokens[, max_new_tokens
+        [, temperature[, eos_id]]])`` tuples, and raises StopIteration when
+        no more will ever come. The iterator is the serving clock: a
+        Poisson front-end yields whatever its queue holds. When NO slots
+        are live, serve() re-polls immediately — a front-end should block
+        briefly (e.g. ``queue.get(timeout=...)``) on an empty queue, or the
+        idle loop busy-spins a host core.
+
+        Execution model (the 9.5x host-scheduling gap closer): decoding runs
+        as K-step FRAMES — one ``lax.scan``-based jit over a fixed set of
+        slots — with all per-slot state (last token, cached counts, per-row
+        limits/EOS/temperature, RNG, padded block tables) device-resident
+        between frames. The host touches the loop only at frame boundaries:
+        admit arrivals into free slots (KV capacity reserved up front —
+        admission control defers arrivals the pool can't hold), retire
+        finished rows (EOS detection is in-graph; the host replays the emit
+        mask against its mirrors), and grow the shape buckets. Frames are
+        shape-bucketed (width ∈ {prefill_chunk, 1}; power-of-two table and
+        prompt widths) so the jit cache stays O(log).
+
+        While a ``serve`` generator is live it owns the engine's scheduler
+        state — don't interleave ``step()``/``generate()`` calls.
+        """
+        c = self._config
+        steps = frame_steps or c.frame_steps
+        n_slots = frame_slots or c.max_ragged_batch_size
+        arrivals = iter(arrivals)
+        pending = collections.deque()
+        self._rng, frame_rng = jax.random.split(self._rng)
+        slots = DeviceSlotTable(
+            n_slots, prompt_width=c.prefill_chunk_size,
+            table_width=1, rng=frame_rng)
+        try:
+            yield from self._serve_loop(slots, arrivals, pending, steps,
+                                        max_new_tokens, temperature,
+                                        eos_token_id)
+        finally:
+            # generator abandonment (break / close() / mid-stream error)
+            # must not strand in-flight state: release every slot-held
+            # sequence and every deferred arrival that already has a
+            # descriptor, or their KV blocks leak and a later call reusing
+            # a uid would inherit stale generated tokens.
+            for uid in list(slots.slot_of_uid):
+                self.state.flush_sequence(uid)
+            for item in pending:
+                self.state.flush_sequence(item[0])
+
+    def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
+                    temperature, eos_token_id):
+        c = self._config
+        exhausted = False
+        while True:
+            if not exhausted:
+                try:
+                    batch = next(arrivals)
+                except StopIteration:
+                    exhausted = True
+                    batch = None
+                # validate at ENQUEUE — before any KV reservation is made
+                # for this round, so a bad request can't strand blocks
+                # already reserved for earlier items in the same batch
+                for item in (batch or []):
+                    uid, toks, limit, temp, eos = self._norm_arrival(
+                        item, max_new_tokens, temperature, eos_token_id)
+                    if uid < 0:
+                        raise ValueError(
+                            f"uid={uid}: serve() uids must be >= 0 (-1 is "
+                            "the free-slot sentinel)")
+                    if uid in slots.slot_of_uid or \
+                            any(p[0] == uid for p in pending):
+                        raise ValueError(
+                            f"uid={uid} is already live in the slot table — "
+                            "serve() uids must be unique among in-flight "
+                            "requests")
+                    if uid in self.state.seqs:
+                        raise ValueError(
+                            f"uid={uid} is already tracked by the engine "
+                            "(stale from an earlier put()/generate()?) — "
+                            "flush it before serving, or it would inherit "
+                            "the old descriptor's tokens")
+                    if len(toks) + 2 > self.max_seq_len:
+                        raise ValueError(
+                            f"uid={uid}: prompt of {len(toks)} tokens can "
+                            f"never fit max_seq_len={self.max_seq_len}")
+                    if len(toks) + limit + 1 > self.max_seq_len:
+                        clamped = self.max_seq_len - len(toks) - 1
+                        logger.warning(
+                            f"uid={uid}: prompt ({len(toks)}) + budget "
+                            f"({limit}) + 1 exceeds max_seq_len="
+                            f"{self.max_seq_len}; clamping budget to "
+                            f"{clamped}")
+                        limit = clamped
+                    pending.append((uid, toks, limit, temp, eos))
+            # ---- admission control (FIFO; blocks reserved for the whole
+            # prompt + generation budget up front, so block tables never
+            # grow mid-flight) ----
+            admits = []
+            while pending and len(admits) < slots.free_slots():
+                uid, toks, limit, temp, eos = pending[0]
+                seq = self.state.get_or_create_sequence(uid)
+                if not self.state.ensure_capacity(seq, len(toks) + limit + 1):
+                    if slots.live_count() == 0 and not admits:
+                        raise RuntimeError(
+                            f"uid={uid}: prompt + budget can never fit the "
+                            f"KV pool ({self.kv.free_blocks} blocks free "
+                            "with no live sequences)")
+                    break        # wait for retirements to free blocks
+                pending.popleft()
+                seq.done = False
+                admits.append((uid, seq, toks, limit, temp, eos))
+            if admits:
+                slots.ensure_widths(
+                    max(len(a[2]) for a in admits),
+                    max(len(a[1].blocks) for a in admits),
+                    self.max_seq_len, self.max_blocks_per_seq)
+                slots.admit(admits)
+            if slots.live_count() == 0:
+                if exhausted and not pending:
+                    return
+                continue         # arrival gap: poll the clock again
+            # ---- frame plan: wide while any slot prefills, else pure
+            # decode at width 1 (two shape buckets total) ----
+            width = c.prefill_chunk_size if slots.any_prefilling() else 1
+            toks, emit = slots.run_frame(self.runner, self.params, self.kv,
+                                         width, steps, slots.all_greedy())
+            emissions, finished = slots.absorb(toks, emit, width)
+            for uid, new_toks in emissions.items():
+                seq = self.state.seqs[uid]
+                seq.generated.extend(new_toks)
+                seq.seen_tokens = int(slots.cached_h[slots.slot_of_uid[uid]])
+            for uid in finished:
+                seq = self.state.seqs[uid]
+                seq.done = True
+                out = np.asarray(seq.generated, np.int64)
+                slots.retire(uid)
+                self.state.flush_sequence(uid)
+                yield uid, out
 
     def serialize(self, path: str):
         """Analog of ``engine_v2.py:251`` — snapshot params for fast reload."""

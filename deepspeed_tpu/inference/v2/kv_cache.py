@@ -35,6 +35,24 @@ class BlockedKVCache:
     def blocks_for(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
 
+    def reserve_trash_block(self) -> None:
+        """Pin block 0 as the trash block: padded/frozen rows' writes (and
+        pad-position reads) are routed there, so it must never be handed to
+        a sequence. Call once, right after construction."""
+        got = self.allocator.allocate(1)
+        assert got == [0], "trash block must be block 0 (allocate first)"
+
+    @staticmethod
+    def bucket_width(need: int, cap: int) -> int:
+        """Next power of two >= ``need``, clamped to ``cap``. Shape buckets
+        for block-table width and batch size: attention cost and jit-cache
+        population both scale with the padded width, so bucketing keeps the
+        compile count O(log) while padding waste stays < 2x."""
+        w = 1
+        while w < min(need, cap):
+            w *= 2
+        return min(w, cap)
+
     @property
     def free_blocks(self) -> int:
         return self.allocator.free_blocks

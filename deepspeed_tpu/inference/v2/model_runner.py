@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ...models import layers as L
 from ...models.transformer import CausalLM
 from ...ops.attention import decode_attention
+from ..sampling import sample_logits_per_row
 
 
 def _use_pallas_paged() -> bool:
@@ -287,52 +288,25 @@ class PagedModelRunner:
             and the updated pools.
             """
             b = prompts.shape[0]
+            # no EOS in this loop (host truncates after); sampled ids are
+            # never negative, so -1 can't match. Uniform per-row temps make
+            # the scalar-temperature sampling bit-identical to before.
+            no_eos = jnp.full((b,), -1, jnp.int32)
+            temps = jnp.full((b,), temperature, jnp.float32)
 
             def make_body(width):
-                offs = jnp.arange(width)
-
-                def body(carry, _):
-                    cached, produced, last_tok, rng, kpool, vpool = carry
-                    prefilling = cached < prompt_lens
-                    active = prefilling | (produced < new_limits)
-                    w = jnp.where(
-                        prefilling,
-                        jnp.minimum(width, prompt_lens - cached),
-                        jnp.where(active, jnp.minimum(width, 1), 0))
-                    idx = jnp.clip(cached[:, None] + offs[None, :], 0,
-                                   prompts.shape[1] - 1)
-                    ids = jnp.where(prefilling[:, None],
-                                    jnp.take_along_axis(prompts, idx, axis=1),
-                                    jnp.where(offs[None, :] == 0,
-                                              last_tok[:, None], 0))
-                    mask = offs[None, :] < w[:, None]
-                    positions = jnp.where(mask, cached[:, None] + offs[None, :],
-                                          -1)
-                    logits, kpool, vpool = fwd(params, ids, positions,
-                                               block_tables, w, kpool, vpool)
-                    if greedy:
-                        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                    else:
-                        rng, sub = jax.random.split(rng)
-                        nxt = jax.random.categorical(
-                            sub, logits / jnp.maximum(temperature, 1e-6),
-                            axis=-1).astype(jnp.int32)
-                    completes = prefilling & (cached + w == prompt_lens)
-                    emit = (completes | (~prefilling & active))
-                    last_tok = jnp.where(emit, nxt, last_tok)
-                    return ((cached + w, produced + emit.astype(jnp.int32),
-                             last_tok, rng, kpool, vpool),
-                            (jnp.where(emit, nxt, -1), emit))
-
-                return body
+                return _serving_scan_body(fwd, params, prompts, prompt_lens,
+                                          new_limits, no_eos, temps,
+                                          block_tables, width, greedy)
 
             zero = jnp.zeros((b,), jnp.int32)
-            carry = (zero, zero, zero, rng, kpool, vpool)
+            carry = (zero, zero, zero, jnp.zeros((b,), bool), rng, kpool,
+                     vpool)
             carry, (toks_w, emit_w) = jax.lax.scan(
                 make_body(chunk), carry, None, length=wide_steps)
             carry, (toks_n, emit_n) = jax.lax.scan(
                 make_body(1), carry, None, length=narrow_steps)
-            kpool, vpool = carry[4], carry[5]
+            kpool, vpool = carry[5], carry[6]
             return (jnp.concatenate([toks_w, toks_n]),
                     jnp.concatenate([emit_w, emit_n]), kpool, vpool)
 
@@ -343,10 +317,107 @@ class PagedModelRunner:
             self._fns["mixed"] = self._build_mixed_loop()
         return self._fns["mixed"](*args, **kwargs)
 
+    def _build_frame_loop(self):
+        fwd = self._forward
+
+        @functools.partial(jax.jit, donate_argnums=(7, 8, 9, 10, 11, 12, 13),
+                           static_argnames=("width", "steps", "greedy"))
+        def loop(params, prompts, prompt_lens, limits, eos_ids, temps, tables,
+                 cached, produced, last_tok, done, rng, kpool, vpool,
+                 width, steps, greedy):
+            """One K-step serving FRAME: the resumable generalization of
+            ``mixed_loop``. All per-slot state is carry-IN/carry-OUT, so the
+            host only touches the loop at frame boundaries (admit arrivals,
+            retire finished rows); between frames the state — last token,
+            cached-token counts, per-row limits, EOS/temperature vectors,
+            RNG — never leaves the device.
+
+            Slot semantics per step: a row with ``cached < prompt_lens``
+            prefills (consumes up to ``width`` prompt tokens); a row past its
+            prompt with ``produced < limits`` decodes one token; rows with
+            ``done`` set (in-graph EOS) or at their limit freeze — their
+            positions go to -1, which the pager routes to the trash block.
+            Free slots are rows with ``done=True, limits=0``.
+
+            Returns (tokens (steps, B), emit (steps, B), new carry...). All
+            carry arrays + pools are donated: the frame updates them in
+            place and the outputs ARE the next frame's inputs.
+            """
+            body = _serving_scan_body(fwd, params, prompts, prompt_lens,
+                                      limits, eos_ids, temps, tables, width,
+                                      greedy)
+            carry = (cached, produced, last_tok, done, rng, kpool, vpool)
+            carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
+            return (toks, emit) + carry
+
+        return loop
+
+    def frame_loop(self, *args, **kwargs):
+        if "frame" not in self._fns:
+            self._fns["frame"] = self._build_frame_loop()
+        return self._fns["frame"](*args, **kwargs)
+
     def run(self, chunk: int, *args):
         if chunk not in self._fns:
             self._fns[chunk] = self._build(chunk)
         return self._fns[chunk](*args)
+
+    def compile_count(self) -> int:
+        """Total compiled executables across every cached entry point —
+        each jitted wrapper retraces per distinct arg shape/static combo,
+        so this is the real program count (the recompile-budget tests
+        assert it stays O(log) in batch size / table width)."""
+        return sum(f._cache_size() for f in self._fns.values()
+                   if hasattr(f, "_cache_size"))
+
+
+def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
+                       temps, tables, width, greedy):
+    """Shared scan-step for ``mixed_loop`` and ``frame_loop`` — the in-graph
+    SplitFuse scheduling arithmetic lives in exactly one place.
+
+    Carry: (cached, produced, last_tok, done, rng, kpool, vpool). Per step, a
+    row with ``cached < prompt_lens`` prefills (consumes up to ``width``
+    prompt tokens); a row past its prompt with ``produced < limits`` decodes
+    one token; ``done`` rows (in-graph EOS) and rows at their limit freeze —
+    width 0, positions -1, which the pager routes to the trash block.
+    ``eos_ids``/``temps`` are per-row; pass eos_ids = -1 for "no EOS" (token
+    ids are never negative) and uniform temps for scalar-temperature callers.
+    Emits (token-or--1, emit-mask) per step."""
+    offs = jnp.arange(width)
+
+    def body(carry, _):
+        cached, produced, last_tok, done, rng, kpool, vpool = carry
+        prefilling = cached < prompt_lens
+        active = ~done & (prefilling | (produced < limits))
+        w = jnp.where(
+            active,
+            jnp.where(prefilling,
+                      jnp.minimum(width, prompt_lens - cached), 1),
+            0)
+        idx = jnp.clip(cached[:, None] + offs[None, :], 0,
+                       prompts.shape[1] - 1)
+        ids = jnp.where(prefilling[:, None],
+                        jnp.take_along_axis(prompts, idx, axis=1),
+                        jnp.where(offs[None, :] == 0, last_tok[:, None], 0))
+        mask = offs[None, :] < w[:, None]
+        positions = jnp.where(mask, cached[:, None] + offs[None, :], -1)
+        logits, kpool, vpool = fwd(params, ids, positions, tables, w,
+                                   kpool, vpool)
+        if greedy:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        else:
+            rng, sub = jax.random.split(rng)
+            nxt = sample_logits_per_row(logits, sub, temps)
+        completes = active & prefilling & (cached + w == prompt_lens)
+        emit = completes | (~prefilling & active)
+        last_tok = jnp.where(emit, nxt, last_tok)
+        done = done | (emit & (nxt == eos_ids))
+        return ((cached + w, produced + emit.astype(jnp.int32),
+                 last_tok, done, rng, kpool, vpool),
+                (jnp.where(emit, nxt, -1), emit))
+
+    return body
 
 
 def _paged_attention(q, kpages, vpages, positions, cfg, window=None,

@@ -1,13 +1,18 @@
 """Sequence state tracking for continuous batching.
 
 Analog of ``inference/v2/ragged/ragged_manager.py:19`` (DSStateManager) and
-``sequence_descriptor.py`` (DSSequenceDescriptor).
+``sequence_descriptor.py`` (DSSequenceDescriptor), plus the device-side slot
+table backing the frame-based serving loop: per-slot state (last token,
+cached-token counts, per-row limits/EOS/temperature, padded block tables)
+lives on DEVICE between frames; the host keeps numpy mirrors purely for
+admission control and never reads slot state back mid-frame.
 """
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Dict, List, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
 
 @dataclasses.dataclass
@@ -62,10 +67,201 @@ class DSStateManager:
         if seq is not None and seq.blocks:
             self.kv_cache.allocator.free(seq.blocks)
 
-    def block_table(self, seq: DSSequenceDescriptor, max_blocks: int) -> jnp.ndarray:
-        tbl = seq.blocks + [0] * (max_blocks - len(seq.blocks))
-        return jnp.asarray(tbl[:max_blocks], jnp.int32)
+    @staticmethod
+    def block_table(seq: DSSequenceDescriptor, max_blocks: int) -> np.ndarray:
+        """Padded block-table ROW as host numpy. Callers stack rows and ship
+        ONE device transfer per step — returning a jnp array here cost a
+        host->device round trip per sequence per call."""
+        if len(seq.blocks) > max_blocks:
+            # never truncate: positions past a truncated table would gather
+            # a wrong page and silently overwrite live KV
+            raise ValueError(
+                f"uid={seq.uid}: {len(seq.blocks)} blocks exceed the "
+                f"{max_blocks}-wide table (sequence past max_seq_len?)")
+        tbl = np.zeros((max_blocks,), np.int32)
+        tbl[:len(seq.blocks)] = seq.blocks
+        return tbl
 
     @property
     def tracked_sequences(self):
         return dict(self.seqs)
+
+
+class DeviceSlotTable:
+    """Fixed set of serving slots whose state is device-resident.
+
+    The frame loop (``PagedModelRunner.frame_loop``) reads and writes these
+    arrays as a donated carry; between frames they simply stay on device.
+    The host mirrors (``*_h`` numpy arrays, ``uid_of_slot``/``slot_of_uid``)
+    exist only so admission control and retirement can be decided without a
+    device read-back: ``absorb`` replays the frame's emit mask against the
+    mirrors using the exact arithmetic of the in-graph body, so mirror and
+    device state never diverge.
+
+    A free slot is a frozen row: ``done=True, limits=0`` — the frame body
+    gives it width 0, its positions go to -1, and the pager routes its
+    (masked) writes to the trash block.
+    """
+
+    def __init__(self, n_slots: int, prompt_width: int, table_width: int, rng):
+        self.n_slots = n_slots
+        zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+        # device state (frame-loop inputs; carry arrays are donated)
+        self.prompts = zi(n_slots, max(1, prompt_width))
+        self.prompt_lens = zi(n_slots)
+        self.limits = zi(n_slots)
+        self.eos_ids = jnp.full((n_slots,), -1, jnp.int32)
+        self.temps = jnp.zeros((n_slots,), jnp.float32)
+        self.tables = zi(n_slots, max(1, table_width))
+        self.cached = zi(n_slots)
+        self.produced = zi(n_slots)
+        self.last_tok = zi(n_slots)
+        self.done = jnp.ones((n_slots,), bool)
+        self.rng = rng
+        # host mirrors — admission control only
+        self.uid_of_slot = np.full((n_slots,), -1, np.int64)
+        self.slot_of_uid: Dict[int, int] = {}
+        self.cached_h = np.zeros((n_slots,), np.int64)
+        self.plen_h = np.zeros((n_slots,), np.int64)
+        self.produced_h = np.zeros((n_slots,), np.int64)
+        self.limit_h = np.zeros((n_slots,), np.int64)
+        self.eos_h = np.full((n_slots,), -1, np.int64)
+        self.temps_h = np.zeros((n_slots,), np.float64)
+        self.done_h = np.ones((n_slots,), bool)
+
+    # ---------------- host-mirror queries (no device sync) ----------------
+
+    def free_slots(self) -> int:
+        return int((self.uid_of_slot < 0).sum())
+
+    def live_count(self) -> int:
+        return self.n_slots - self.free_slots()
+
+    def any_prefilling(self) -> bool:
+        live = self.uid_of_slot >= 0
+        return bool(np.any(live & (self.cached_h < self.plen_h)))
+
+    def all_greedy(self) -> bool:
+        live = self.uid_of_slot >= 0
+        return bool(np.all(self.temps_h[live] <= 0.0))
+
+    # ---------------- frame-boundary mutations ----------------
+
+    def ensure_widths(self, prompt_need: int, table_need: int,
+                      prompt_cap: int, table_cap: int) -> None:
+        """Grow the padded prompt buffer / block-table width to the next
+        power-of-two bucket (keeps the jit cache O(log) in table width).
+        Admission control guarantees ``need <= cap`` (over-context requests
+        are clamped or rejected before they reach the slot table)."""
+        from .kv_cache import BlockedKVCache
+        assert prompt_need <= prompt_cap and table_need <= table_cap, \
+            "admission let an over-context request through"
+        p = self.prompts.shape[1]
+        if prompt_need > p:
+            new_p = BlockedKVCache.bucket_width(prompt_need, prompt_cap)
+            self.prompts = jnp.pad(self.prompts, ((0, 0), (0, new_p - p)))
+        t = self.tables.shape[1]
+        if table_need > t:
+            new_t = BlockedKVCache.bucket_width(table_need, table_cap)
+            self.tables = jnp.pad(self.tables, ((0, 0), (0, new_t - t)))
+
+    def admit(self, items: List[Tuple]) -> None:
+        """Admit arrivals into free slots: ``items`` is a list of
+        (uid, seq, prompt_tokens, limit, temperature, eos_id). All device
+        writes are batched — one ``.at[rows].set`` per array, regardless of
+        how many sequences arrive at this frame boundary."""
+        free = [i for i in range(self.n_slots) if self.uid_of_slot[i] < 0]
+        assert len(items) <= len(free), "admit() beyond free slots"
+        p_w = int(self.prompts.shape[1])
+        t_w = int(self.tables.shape[1])
+        rows, p_rows, t_rows = [], [], []
+        plens, lims, eoss, temps = [], [], [], []
+        for (uid, seq, toks, limit, temp, eos), slot in zip(items, free):
+            toks = np.asarray(toks, np.int32).reshape(-1)
+            self.uid_of_slot[slot] = uid
+            self.slot_of_uid[uid] = slot
+            seq.slot = slot
+            self.cached_h[slot] = 0
+            self.plen_h[slot] = len(toks)
+            self.produced_h[slot] = 0
+            self.limit_h[slot] = limit
+            self.eos_h[slot] = -1 if eos is None else eos
+            self.temps_h[slot] = temp
+            self.done_h[slot] = False
+            p_row = np.zeros((p_w,), np.int32)
+            p_row[:len(toks)] = toks
+            # shared helper keeps the no-truncate guard in one place
+            t_row = DSStateManager.block_table(seq, t_w)
+            rows.append(slot)
+            p_rows.append(p_row)
+            t_rows.append(t_row)
+            plens.append(len(toks))
+            lims.append(limit)
+            eoss.append(-1 if eos is None else eos)
+            temps.append(temp)
+        idx = jnp.asarray(rows, jnp.int32)
+        self.prompts = self.prompts.at[idx].set(jnp.asarray(np.stack(p_rows)))
+        self.tables = self.tables.at[idx].set(jnp.asarray(np.stack(t_rows)))
+        self.prompt_lens = self.prompt_lens.at[idx].set(
+            jnp.asarray(plens, jnp.int32))
+        self.limits = self.limits.at[idx].set(jnp.asarray(lims, jnp.int32))
+        self.eos_ids = self.eos_ids.at[idx].set(jnp.asarray(eoss, jnp.int32))
+        self.temps = self.temps.at[idx].set(jnp.asarray(temps, jnp.float32))
+        zero = jnp.zeros((len(rows),), jnp.int32)
+        self.cached = self.cached.at[idx].set(zero)
+        self.produced = self.produced.at[idx].set(zero)
+        self.last_tok = self.last_tok.at[idx].set(zero)
+        self.done = self.done.at[idx].set(False)
+
+    def retire(self, uid: int) -> None:
+        """Free the slot on the host side; the device row is already frozen
+        (EOS set ``done`` in-graph, a limit-finisher sits at
+        ``produced == limits`` — either way the frame body gives it width 0
+        until ``admit`` rewrites the row)."""
+        slot = self.slot_of_uid.pop(uid)
+        self.uid_of_slot[slot] = -1
+        self.done_h[slot] = True
+
+    # ---------------- frame execution + host replay ----------------
+
+    def run_frame(self, runner, params, kv, width: int, steps: int,
+                  greedy: bool):
+        """Execute one K-step frame and swap the donated carry in place.
+        The only device→host transfer is the (steps, B) token/emit pair."""
+        (toks, emit, self.cached, self.produced, self.last_tok, self.done,
+         self.rng, kv.k, kv.v) = runner.frame_loop(
+            params, self.prompts, self.prompt_lens, self.limits, self.eos_ids,
+            self.temps, self.tables, self.cached, self.produced, self.last_tok,
+            self.done, self.rng, kv.k, kv.v,
+            width=width, steps=steps, greedy=greedy)
+        return np.asarray(toks), np.asarray(emit)
+
+    def absorb(self, toks: np.ndarray, emit: np.ndarray, width: int):
+        """Replay the frame against the host mirrors (same arithmetic as the
+        in-graph body) → ({uid: [tokens emitted this frame]}, [finished uids]).
+        A row finishes when it emits its EOS or reaches its token limit."""
+        emissions: Dict[int, List[int]] = {}
+        finished: List[int] = []
+        live = [i for i in range(self.n_slots) if self.uid_of_slot[i] >= 0]
+        for s in range(toks.shape[0]):
+            for i in live:
+                if self.done_h[i]:
+                    continue
+                if self.cached_h[i] < self.plen_h[i]:
+                    self.cached_h[i] += min(width,
+                                            self.plen_h[i] - self.cached_h[i])
+                elif self.produced_h[i] < self.limit_h[i]:
+                    self.cached_h[i] += 1
+                else:
+                    continue
+                if emit[s, i]:
+                    t = int(toks[s, i])
+                    uid = int(self.uid_of_slot[i])
+                    emissions.setdefault(uid, []).append(t)
+                    self.produced_h[i] += 1
+                    if t == self.eos_h[i] or self.produced_h[i] >= self.limit_h[i]:
+                        self.done_h[i] = True
+        for i in live:
+            if self.done_h[i]:
+                finished.append(int(self.uid_of_slot[i]))
+        return emissions, finished
