@@ -33,8 +33,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def _logs_to_stderr():
     """The package logger streams to stdout (reference behavior); the bench
-    must keep stdout pure JSON so `> SERVING_rNN.json` works as documented."""
-    for h in logging.getLogger("DeepSpeedTPU").handlers:
+    must keep stdout pure JSON so `> SERVING_rNN.json` works as documented.
+    Importing the logger first forces its handler to exist — redirecting
+    before the package's lazy first import would silently do nothing."""
+    from deepspeed_tpu.utils.logging import logger as _pkg_logger
+    for h in _pkg_logger.handlers:
         if hasattr(h, "stream"):
             h.stream = sys.stderr
 
@@ -226,6 +229,32 @@ def bench_mixed(model_name, batch, prompt_len, new_tokens):
     }
 
 
+def _poisson_schedule(vocab, prompt_len, n_arrivals, rate_hz, seed=3):
+    """The shared Poisson arrival schedule (fixed seed): every dynamic
+    serving contender — frame loop, speculative frame loop, host step loop —
+    must measure against the SAME (prompts, offsets), or the side-by-side
+    columns stop being comparable."""
+    rng = np.random.default_rng(seed)
+    prompts = [rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
+               for _ in range(n_arrivals)]
+    gaps = rng.exponential(1.0 / rate_hz, n_arrivals)
+    gaps[0] = 0.0
+    return prompts, np.cumsum(gaps)
+
+
+def _wallclock_arrivals(prompts, offsets, t_start):
+    """serve() arrivals clock: each poll yields whatever the schedule says
+    is due by now (possibly nothing)."""
+    nxt = 0
+    while nxt < len(prompts):
+        now = time.perf_counter() - t_start
+        due = []
+        while nxt < len(prompts) and offsets[nxt] <= now:
+            due.append((nxt, prompts[nxt]))
+            nxt += 1
+        yield due
+
+
 def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
                         n_arrivals=32, rate_hz=40.0, frame_steps=8):
     """Dynamic arrivals (Poisson, fixed seed): the frame-based serve() loop
@@ -237,29 +266,13 @@ def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
     from deepspeed_tpu.inference.v2.ragged_manager import DeviceSlotTable
     eng = _mk_engine(model_name, batch,
                      expected_context=prompt_len + new_tokens)
-    rng = np.random.default_rng(3)
-    vocab = eng.model.cfg.vocab_size
-    prompts = [rng.integers(0, vocab, (prompt_len,)).astype(np.int32)
-               for _ in range(n_arrivals)]
-    gaps = rng.exponential(1.0 / rate_hz, n_arrivals)
-    gaps[0] = 0.0
-    offsets = np.cumsum(gaps)
+    prompts, offsets = _poisson_schedule(eng.model.cfg.vocab_size, prompt_len,
+                                         n_arrivals, rate_hz)
 
     def run_frames():
         """serve() with wall-clock Poisson arrivals; returns (produced, dt,
         device_time) — dt - device_time is the host boundary cost."""
-        t_start = time.perf_counter()
-
-        def arrivals():
-            nxt = 0
-            while nxt < n_arrivals:
-                now = time.perf_counter() - t_start
-                due = []
-                while nxt < n_arrivals and offsets[nxt] <= now:
-                    due.append((nxt, prompts[nxt]))
-                    nxt += 1
-                yield due
-
+        arrivals = _wallclock_arrivals(prompts, offsets, time.perf_counter())
         dev_box = [0.0]
         orig_run = DeviceSlotTable.run_frame
 
@@ -273,7 +286,7 @@ def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
         produced = 0
         try:
             t0 = time.perf_counter()
-            for _uid, toks in eng.serve(arrivals(), max_new_tokens=new_tokens,
+            for _uid, toks in eng.serve(arrivals, max_new_tokens=new_tokens,
                                         frame_steps=frame_steps):
                 produced += len(toks)
             dt = time.perf_counter() - t0
@@ -337,6 +350,66 @@ def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
                 "the device-resident frame loop (host touches the loop only "
                 "at frame boundaries), host_step_tok_per_sec the per-step "
                 "host scheduler this PR retires for dynamic traffic",
+    }
+
+
+def bench_mixed_dynamic_spec(model_name, batch, prompt_len, new_tokens,
+                             n_arrivals=32, rate_hz=40.0, frame_steps=8,
+                             gamma=2):
+    """Speculative decoding on the frame carry, measured on the SAME
+    mixed-splitfuse-dynamic Poisson schedule as the non-speculative frame
+    loop and the host step loop (same seed => identical arrival offsets).
+
+    The draft is a SELF-draft (draft == target params): the high-acceptance
+    upper bound, so ``tokens_per_target_forward`` approaches gamma+1 and the
+    row isolates the architecture win (fewer target forwards per emitted
+    token, zero extra host<->device transfers inside a frame) from draft
+    quality. Wall-clock speedup additionally depends on the draft/target
+    cost ratio — a self-draft pays the full target cost per proposal, so on
+    real deployments expect a small draft and read acceptance_rate +
+    tokens_per_target_forward to size the win."""
+    base = bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
+                               n_arrivals=n_arrivals, rate_hz=rate_hz,
+                               frame_steps=frame_steps)
+    eng = _mk_engine(model_name, batch,
+                     expected_context=prompt_len + new_tokens)
+    eng.attach_draft(eng.model, eng.params)
+    prompts, offsets = _poisson_schedule(eng.model.cfg.vocab_size, prompt_len,
+                                         n_arrivals, rate_hz)
+
+    def run_spec():
+        arrivals = _wallclock_arrivals(prompts, offsets, time.perf_counter())
+        produced = 0
+        t0 = time.perf_counter()
+        for _uid, toks in eng.serve(arrivals, max_new_tokens=new_tokens,
+                                    frame_steps=frame_steps, gamma=gamma):
+            produced += len(toks)
+        return produced, time.perf_counter() - t0
+
+    run_spec()                                     # compile both widths
+    produced, dt = run_spec()
+    sp = eng.serve_stats["spec"]
+    spec_tps = round(produced / dt, 1)
+    return {
+        "workload": "mixed-splitfuse-dynamic-spec", "batch": batch,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "arrivals": n_arrivals, "arrival_rate_hz": rate_hz,
+        "frame_steps": frame_steps, "gamma": gamma, "draft": "self",
+        "acceptance_rate": sp["acceptance_rate"],
+        "tokens_per_target_forward": sp["tokens_per_target_forward"],
+        "spec_frame_tok_per_sec": spec_tps,
+        "frame_tok_per_sec": base.get("frame_tok_per_sec"),
+        "host_step_tok_per_sec": base.get("host_step_tok_per_sec"),
+        "spec_vs_frame_speedup": round(
+            spec_tps / base["frame_tok_per_sec"], 2)
+            if base.get("frame_tok_per_sec") else None,
+        "spec_vs_host_step_speedup": round(
+            spec_tps / base["host_step_tok_per_sec"], 2)
+            if base.get("host_step_tok_per_sec") else None,
+        "note": "same Poisson schedule for all three loops; the self-draft "
+                "row bounds acceptance from above — wall-clock speedup on "
+                "real serving scales with (1 + acceptance*gamma) / "
+                "(1 + gamma*draft_cost_ratio)",
     }
 
 
@@ -495,7 +568,17 @@ def bench_kernel_delta(model_name, batch, prompt_len, new_tokens, repeats=2):
 
 
 def main():
+    import argparse
     import jax
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--speculate", action="store_true",
+                    help="run the speculative-decoding serving rows "
+                         "(mixed-splitfuse-dynamic Poisson schedule: "
+                         "acceptance rate, tokens/target-forward, and the "
+                         "frame-vs-host-step speedup side by side)")
+    ap.add_argument("--gamma", type=int, default=2,
+                    help="draft tokens per target verify (default 2)")
+    args = ap.parse_args()
     _logs_to_stderr()
     platform = jax.default_backend()
     if platform == "tpu":
@@ -536,6 +619,30 @@ def main():
         except Exception as e:
             add({"workload": tag, "status": "failed",
                  "error_type": type(e).__name__, "error": str(e)[:300]})
+
+    if args.speculate:
+        # focused mode: the speculative serving rows only (the spec bench
+        # internally re-runs the non-spec frame + host-step contenders on
+        # the same Poisson schedule for the side-by-side columns)
+        b, p, n, arr = mixed_dynamic
+        # speculation only engages on pure-decode (width-1) frames: give the
+        # schedule enough decode budget that rows outlive the prefill frames
+        spec_frame_steps = 8
+        n = max(n, 3 * spec_frame_steps)
+        guarded("mixed-splitfuse-dynamic-spec", bench_mixed_dynamic_spec,
+                model, b, p, n, n_arrivals=arr, gamma=args.gamma,
+                frame_steps=spec_frame_steps)
+        spec_rows = [r for r in rows
+                     if r.get("workload") == "mixed-splitfuse-dynamic-spec"]
+        best = max((r.get("spec_frame_tok_per_sec", 0) or 0
+                    for r in spec_rows), default=0)
+        print(json.dumps({
+            "metric": "fastgen_serving_speculative",
+            "model": model, "platform": platform,
+            "value": best, "unit": "speculative serve tokens/s",
+            "rows": rows,
+        }))
+        return
 
     for b, p, n in decode_cfgs:
         guarded("decode-heavy", bench_decode, model, b, p, n)

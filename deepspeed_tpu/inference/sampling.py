@@ -17,6 +17,65 @@ def sample_logits_per_row(logits, rng, temps):
     return jnp.where(temps <= 0.0, greedy_toks, sampled)
 
 
+def speculative_verify_per_row(target_logits, draft_logits, draft_toks, temps,
+                               rng=None):
+    """Per-row draft verification for the speculative serving frame: decides
+    how many drafted tokens survive and what the replacement/bonus token is,
+    entirely in-graph (acceptance never syncs the host).
+
+    target_logits: (B, G+1, V) the target model's logits at the G+1 verified
+    positions (position 0 is the committed last token; positions 1..G are the
+    drafted tokens). draft_logits: (B, G, V) the draft's proposal logits.
+    draft_toks: (B, G) the proposed tokens. temps: (B,) per-row temperatures.
+
+    Returns (n_accept (B,) int32 in [0, G], replacement (B,) int32): the
+    count of leading accepted drafts and the token to emit right after them —
+    the target's continuation on full acceptance, its correction at the first
+    rejected position otherwise.
+
+    Rows with temp <= 0 use exact greedy token-match (accept while the draft
+    token equals the target argmax), which makes the speculative output
+    bit-identical to non-speculative greedy decoding. Rows with temp > 0 use
+    Leviathan-style rejection sampling: accept q_j with probability
+    min(1, p_t(q_j) / p_d(q_j)); on the first rejection the replacement is
+    drawn from the normalized residual max(p_t - p_d, 0), which preserves the
+    target distribution exactly. ``rng=None`` means all rows are greedy and
+    no randomness is consumed."""
+    g = draft_toks.shape[1]
+    tgt_greedy = jnp.argmax(target_logits, axis=-1).astype(jnp.int32)  # (B, G+1)
+    match = (draft_toks == tgt_greedy[:, :g]).astype(jnp.int32)
+    # leading-ones count: cumprod zeroes everything after the first mismatch
+    greedy_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1).astype(jnp.int32)
+    greedy_repl = jnp.take_along_axis(tgt_greedy, greedy_acc[:, None],
+                                      axis=1)[:, 0]
+    if rng is None:
+        return greedy_acc, greedy_repl
+    r_u, r_res = jax.random.split(rng)
+    t = jnp.maximum(temps, 1e-6)[:, None, None]
+    p_t = jax.nn.softmax(target_logits.astype(jnp.float32) / t, axis=-1)
+    p_d = jax.nn.softmax(draft_logits.astype(jnp.float32) / t, axis=-1)
+    pt_q = jnp.take_along_axis(p_t[:, :g], draft_toks[..., None], -1)[..., 0]
+    pd_q = jnp.take_along_axis(p_d, draft_toks[..., None], -1)[..., 0]
+    u = jax.random.uniform(r_u, draft_toks.shape)
+    accept = (u * pd_q <= pt_q).astype(jnp.int32)   # accept w.p. min(1, pt/pd)
+    samp_acc = jnp.sum(jnp.cumprod(accept, axis=1), axis=1).astype(jnp.int32)
+    n_acc = jnp.where(temps <= 0.0, greedy_acc, samp_acc)
+    # residual at the first rejected position; the bonus position (n_acc == G)
+    # has no draft distribution, so pad p_d with zeros there and the residual
+    # degenerates to p_t itself
+    pd_pad = jnp.concatenate([p_d, jnp.zeros_like(p_d[:, :1])], axis=1)
+    idx = n_acc[:, None, None]
+    p_t_at = jnp.take_along_axis(p_t, idx, axis=1)[:, 0]        # (B, V)
+    p_d_at = jnp.take_along_axis(pd_pad, idx, axis=1)[:, 0]
+    res = jnp.maximum(p_t_at - p_d_at, 0.0)
+    # p_d == p_t exactly (self-draft) leaves a zero residual: fall back to p_t
+    res = jnp.where(jnp.sum(res, axis=-1, keepdims=True) > 0.0, res, p_t_at)
+    sampled_repl = jax.random.categorical(
+        r_res, jnp.log(res + 1e-30), axis=-1).astype(jnp.int32)
+    repl = jnp.where(temps <= 0.0, greedy_repl, sampled_repl)
+    return n_acc, repl
+
+
 def sample_logits(logits, rng, *, temperature: float = 1.0, top_k: int = 0,
                   top_p: float = 1.0, greedy: bool = False):
     """logits: (B, V) → token ids (B,) int32."""

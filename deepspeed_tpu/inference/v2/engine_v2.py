@@ -56,12 +56,25 @@ class RaggedInferenceEngineConfig:
     # host boundary further but delay admission of new arrivals by up to
     # frame_steps decode steps (see README "frame loop" tradeoff).
     frame_steps: int = 8
+    # adaptive frame sizing (ROADMAP item (c)): re-pick the frame length
+    # each frame from the pow2 bucket set {1, 2, ..., frame_steps} using an
+    # EWMA arrival-rate estimate — small frames under bursty TTFT-sensitive
+    # traffic, frame_steps when saturated or drained. The pow2 buckets keep
+    # the frame jit cache O(log) (steps is a static arg).
+    adaptive_frame_steps: bool = False
+    frame_steps_ewma_alpha: float = 0.25
+    # speculative decoding (draft/verify on the frame carry): tokens the
+    # draft proposes per target verify. Emitted tokens per target forward is
+    # 1 + acceptance * gamma, so larger gammas only pay off with a strong
+    # draft (see README "Speculative decoding on the frame carry").
+    speculate_gamma: int = 2
     dtype: str = "bfloat16"
 
 
 class InferenceEngineV2:
     def __init__(self, model, config: Optional[RaggedInferenceEngineConfig] = None,
-                 params=None, max_seq_len: Optional[int] = None):
+                 params=None, max_seq_len: Optional[int] = None,
+                 draft_model=None, draft_params=None):
         self._config = config or RaggedInferenceEngineConfig()
         from ...module_inject import as_inference_model
         self.model, converted = as_inference_model(model, None)
@@ -94,8 +107,75 @@ class InferenceEngineV2:
         self.runner = PagedModelRunner(self.model, bs, max_blocks_per_seq)
         self.max_blocks_per_seq = max_blocks_per_seq
         self._rng = jax.random.PRNGKey(0)
+        self.draft_model = None
+        self.draft_params = None
+        self.draft_runner = None
+        self.draft_kv = None
+        self.serve_stats: Dict = {}
+        if draft_model is not None:
+            self.attach_draft(draft_model, draft_params)
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{bs} "
                  f"budget={c.max_tokens_per_step} chunk={c.prefill_chunk_size}", ranks=[0])
+
+    def attach_draft(self, draft_model, draft_params=None) -> None:
+        """Attach a small draft ``CausalLM`` for speculative decoding.
+
+        The draft gets its OWN paged KV pools sized like the target's
+        (same block count and block size) and indexed by the SAME per-slot
+        block tables — admission reserves blocks once and both models
+        address them, so speculation changes nothing about admission,
+        retirement, or bucket growth. ``draft_params=None`` initializes
+        fresh draft weights; pass the target's params for a self-draft
+        (useful as the 100%-acceptance upper bound in benchmarks)."""
+        from ...module_inject import as_inference_model
+        self.draft_model, converted = as_inference_model(draft_model, None)
+        if draft_params is not None:
+            converted = draft_params
+        if self.draft_model.cfg.dtype != self._config.dtype:
+            self.draft_model.cfg = self.draft_model.cfg.replace(
+                dtype=self._config.dtype)
+        dcfg = self.draft_model.cfg
+        if dcfg.vocab_size != self.model.cfg.vocab_size:
+            raise ValueError(
+                f"draft vocab_size={dcfg.vocab_size} must match the target's "
+                f"{self.model.cfg.vocab_size} — verification compares token "
+                "ids and distributions position-wise")
+        if self._config.prefill_chunk_size < 2:
+            raise ValueError(
+                "speculative serving needs prefill_chunk_size >= 2: width-1 "
+                "frames are reinterpreted as draft/verify steps")
+        if dcfg.max_seq_len < self.max_seq_len:
+            if dcfg.position == "learned":
+                # out-of-table positions would clamp in the embedding gather:
+                # proposals turn to garbage at long contexts with no error,
+                # just collapsed acceptance — fail loudly instead
+                raise ValueError(
+                    f"draft max_seq_len={dcfg.max_seq_len} < engine serving "
+                    f"length {self.max_seq_len}: the draft's learned position "
+                    "table cannot cover the contexts it must draft for")
+            logger.warning(
+                f"draft max_seq_len={dcfg.max_seq_len} < engine serving "
+                f"length {self.max_seq_len}; proposals beyond the draft's "
+                "trained context will likely be rejected (throughput, not "
+                "correctness, degrades)")
+        if converted is None:
+            self.draft_params = self.draft_model.init(jax.random.PRNGKey(1))
+        else:
+            self.draft_params = jax.device_put(converted)
+        c = self._config
+        self.draft_kv = BlockedKVCache(
+            dcfg.num_layers, dcfg.kv_heads, dcfg.dims_per_head,
+            num_blocks=self.kv.num_blocks, block_size=c.kv_block_size,
+            dtype=dcfg.act_dtype)
+        self.draft_runner = PagedModelRunner(self.draft_model, c.kv_block_size,
+                                             self.max_blocks_per_seq)
+        # the speculative loops close over the draft runner's _forward: a
+        # re-attach must evict them or the old draft would keep running
+        self.runner._fns.pop("spec_frame", None)
+        self.runner._fns.pop("spec_mixed", None)
+        log_dist(f"InferenceEngineV2: draft attached "
+                 f"(layers={dcfg.num_layers} gamma={c.speculate_gamma})",
+                 ranks=[0])
 
     # ------------------------------------------------------------------
     # admission control (reference engine_v2.py:184)
@@ -325,13 +405,25 @@ class InferenceEngineV2:
 
     def generate_compiled(self, prompts: List[np.ndarray],
                           max_new_tokens: int = 32, temperature: float = 0.0,
-                          eos_token_id: Optional[int] = None):
+                          eos_token_id: Optional[int] = None,
+                          speculate: Optional[bool] = None,
+                          gamma: Optional[int] = None):
         """Fully-compiled SplitFuse generation: chunked prefill, staggered
         prefill->decode transitions, and decode run as ONE jit (two scans
         sharing per-row state) — no host round-trips between steps. Same
         outputs as ``generate`` for static workloads; ``step()`` remains the
-        path for continuous batching with dynamic arrivals."""
+        path for continuous batching with dynamic arrivals. With a draft
+        attached (or ``speculate=True``) the narrow scan runs speculative
+        draft/verify steps — same outputs under greedy decoding, fewer
+        target forwards per emitted token."""
         c = self._config
+        if speculate is None:
+            speculate = self.draft_model is not None
+        if speculate and self.draft_model is None:
+            raise ValueError("speculate=True but no draft model is attached")
+        gamma = int(gamma if gamma is not None else c.speculate_gamma)
+        if speculate and gamma < 1:
+            raise ValueError(f"speculate needs gamma >= 1, got {gamma}")
         uids = list(range(len(prompts)))
         self.put(uids, prompts)
         seqs = [self.state.seqs[u] for u in uids]
@@ -349,18 +441,34 @@ class InferenceEngineV2:
         chunk = c.prefill_chunk_size
         wide_steps = -(-pmax // chunk)
         self._rng, sub = jax.random.split(self._rng)
-        toks, emit, self.kv.k, self.kv.v = self.runner.mixed_loop(
-            self.params, jnp.asarray(prompts_p), jnp.asarray(plens),
-            jnp.full((b,), max_new_tokens, jnp.int32), self.kv.k, self.kv.v,
-            jnp.asarray(tables), sub, jnp.float32(temperature),
-            chunk=chunk, wide_steps=wide_steps,
-            narrow_steps=max(0, max_new_tokens - 1),
-            greedy=temperature == 0.0)
+        if speculate:
+            (toks, emit, self.kv.k, self.kv.v, self.draft_kv.k,
+             self.draft_kv.v) = self.runner.mixed_loop_spec(
+                self.draft_runner, self.params, self.draft_params,
+                jnp.asarray(prompts_p), jnp.asarray(plens),
+                jnp.full((b,), max_new_tokens, jnp.int32),
+                self.kv.k, self.kv.v, self.draft_kv.k, self.draft_kv.v,
+                jnp.asarray(tables), sub, jnp.float32(temperature),
+                chunk=chunk, wide_steps=wide_steps,
+                narrow_steps=max(0, max_new_tokens - 1),
+                greedy=temperature == 0.0, gamma=gamma)
+        else:
+            toks, emit, self.kv.k, self.kv.v = self.runner.mixed_loop(
+                self.params, jnp.asarray(prompts_p), jnp.asarray(plens),
+                jnp.full((b,), max_new_tokens, jnp.int32), self.kv.k, self.kv.v,
+                jnp.asarray(tables), sub, jnp.float32(temperature),
+                chunk=chunk, wide_steps=wide_steps,
+                narrow_steps=max(0, max_new_tokens - 1),
+                greedy=temperature == 0.0)
         toks = np.asarray(toks)
         emit = np.asarray(emit)
         outs = []
         for i, s in enumerate(seqs):
-            g = [int(t) for t, e in zip(toks[:, i], emit[:, i]) if e]
+            if emit.ndim == 3:   # speculative emissions: flatten (steps, K)
+                g = [int(t) for t, e in zip(toks[:, i, :].reshape(-1),
+                                            emit[:, i, :].reshape(-1)) if e]
+            else:
+                g = [int(t) for t, e in zip(toks[:, i], emit[:, i]) if e]
             g = g[:max_new_tokens]
             if eos_token_id is not None and eos_token_id in g:
                 g = g[: g.index(eos_token_id) + 1]
@@ -392,7 +500,9 @@ class InferenceEngineV2:
     def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
               temperature: float = 0.0, eos_token_id: Optional[int] = None,
               frame_steps: Optional[int] = None,
-              frame_slots: Optional[int] = None):
+              frame_slots: Optional[int] = None,
+              speculate: Optional[bool] = None, gamma: Optional[int] = None,
+              rng=None):
         """Continuous batching with dynamic arrivals at compiled-loop speed.
 
         Generator: yields ``(uid, generated_tokens)`` as sequences finish.
@@ -419,22 +529,70 @@ class InferenceEngineV2:
         shape-bucketed (width ∈ {prefill_chunk, 1}; power-of-two table and
         prompt widths) so the jit cache stays O(log).
 
+        Speculative decoding (``speculate``; defaults to on when a draft is
+        attached): pure-decode frames run ``gamma`` draft proposals plus one
+        gamma+1-wide target verify per step, emitting 1 + accepted tokens
+        per target forward. Acceptance, EOS, and rollback are in-graph; the
+        host replay just reads the wider emit mask, so the frame-boundary
+        contract is unchanged. Per-frame acceptance statistics accumulate in
+        ``self.serve_stats``.
+
+        ``rng`` (key or int seed) makes sampled runs reproducible: it seeds
+        the frame carry's device RNG directly instead of splitting from the
+        engine's stream. ``adaptive_frame_steps`` in the config re-picks the
+        frame length per frame (pow2 buckets up to ``frame_steps``) from an
+        EWMA arrival-rate estimate; an explicit ``frame_steps=`` argument
+        pins it.
+
         While a ``serve`` generator is live it owns the engine's scheduler
         state — don't interleave ``step()``/``generate()`` calls.
         """
+        # argument validation is EAGER (serve() itself is not a generator):
+        # a misconfigured call raises here, at the call site, not at the
+        # first next() deep inside some consumer
         c = self._config
         steps = frame_steps or c.frame_steps
+        adaptive = c.adaptive_frame_steps and frame_steps is None
+        if speculate is None:
+            speculate = self.draft_model is not None
+        if speculate and self.draft_model is None:
+            raise ValueError("speculate=True but no draft model is attached "
+                             "(pass draft_model= at construction or call "
+                             "attach_draft())")
+        gamma = int(gamma if gamma is not None else c.speculate_gamma)
+        if speculate and gamma < 1:
+            raise ValueError(f"speculate needs gamma >= 1, got {gamma}")
         n_slots = frame_slots or c.max_ragged_batch_size
         arrivals = iter(arrivals)
-        pending = collections.deque()
-        self._rng, frame_rng = jax.random.split(self._rng)
+        if rng is None:
+            self._rng, frame_rng = jax.random.split(self._rng)
+        elif isinstance(rng, (int, np.integer)):
+            frame_rng = jax.random.PRNGKey(int(rng))
+        else:
+            frame_rng = rng
         slots = DeviceSlotTable(
             n_slots, prompt_width=c.prefill_chunk_size,
             table_width=1, rng=frame_rng)
+        self.serve_stats = {
+            "frames": 0, "frame_steps_last": None, "frame_steps_hist": {},
+            "arrival_ewma": 0.0, "adaptive_frame_steps": adaptive,
+            "spec": {"gamma": gamma if speculate else 0, "target_forwards": 0,
+                     "emitted_tokens": 0, "accepted_drafts": 0,
+                     "acceptance_rate": None,
+                     "tokens_per_target_forward": None},
+        }
+        return self._serve_guarded(slots, arrivals, steps, max_new_tokens,
+                                   temperature, eos_token_id, speculate,
+                                   gamma, adaptive)
+
+    def _serve_guarded(self, slots, arrivals, steps, max_new_tokens,
+                       temperature, eos_token_id, speculate, gamma, adaptive):
+        pending = collections.deque()
         try:
             yield from self._serve_loop(slots, arrivals, pending, steps,
                                         max_new_tokens, temperature,
-                                        eos_token_id)
+                                        eos_token_id, speculate=speculate,
+                                        gamma=gamma, adaptive=adaptive)
         finally:
             # generator abandonment (break / close() / mid-stream error)
             # must not strand in-flight state: release every slot-held
@@ -446,17 +604,43 @@ class InferenceEngineV2:
             for item in pending:
                 self.state.flush_sequence(item[0])
 
+    @staticmethod
+    def _pick_frame_steps(ewma: float, max_steps: int, saturated: bool) -> int:
+        """Adaptive frame length (ROADMAP item (c)): the pow2 bucket whose
+        size roughly admits one expected arrival per frame — bursty traffic
+        gets small frames (arrivals wait at most frame_steps decode steps
+        for admission), while a saturated table (no free slots: admission
+        can't act anyway) or a drained arrival stream gets the full
+        ``max_steps`` to amortize the host boundary. Buckets are
+        {pow2 <= max_steps} ∪ {max_steps}, keeping the frame jit cache
+        O(log) in the face of a static ``steps`` argument."""
+        if saturated or ewma < 0.125:
+            return max_steps
+        target = max(1.0, max_steps / (1.0 + ewma))
+        p = 1
+        while p * 2 <= target:
+            p *= 2
+        return min(p, max_steps)
+
     def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
-                    temperature, eos_token_id):
+                    temperature, eos_token_id, speculate=False, gamma=0,
+                    adaptive=False):
         c = self._config
+        stats = self.serve_stats
+        alpha = c.frame_steps_ewma_alpha
+        ewma = 0.0
         exhausted = False
         while True:
-            if not exhausted:
+            if exhausted:
+                batch = None
+                ewma = (1.0 - alpha) * ewma
+            else:
                 try:
                     batch = next(arrivals)
                 except StopIteration:
                     exhausted = True
                     batch = None
+                ewma = alpha * len(batch or []) + (1.0 - alpha) * ewma
                 # validate at ENQUEUE — before any KV reservation is made
                 # for this round, so a bad request can't strand blocks
                 # already reserved for earlier items in the same batch
@@ -520,15 +704,52 @@ class InferenceEngineV2:
                     return
                 continue         # arrival gap: poll the clock again
             # ---- frame plan: wide while any slot prefills, else pure
-            # decode at width 1 (two shape buckets total) ----
+            # decode at width 1 (two shape buckets total; width-1 frames
+            # are the speculative draft/verify frames when a draft rides) ----
             width = c.prefill_chunk_size if slots.any_prefilling() else 1
+            cur_steps = steps
+            if adaptive:
+                cur_steps = self._pick_frame_steps(
+                    ewma, steps, slots.free_slots() == 0)
+            stats["arrival_ewma"] = round(ewma, 4)
+            stats["frame_steps_last"] = cur_steps
+            stats["frame_steps_hist"][cur_steps] = \
+                stats["frame_steps_hist"].get(cur_steps, 0) + 1
+            stats["frames"] += 1
+            draft = None
+            if speculate:
+                draft = (self.draft_runner, self.draft_params, self.draft_kv,
+                         gamma)
             toks, emit = slots.run_frame(self.runner, self.params, self.kv,
-                                         width, steps, slots.all_greedy())
+                                         width, cur_steps, slots.all_greedy(),
+                                         draft=draft)
+            if speculate and width == 1:
+                # column 0 of the emit mask marks an active row-step — i.e.
+                # one target verify forward; extra columns are accepted
+                # drafts. Accepted-but-not-emitted drafts (budget/EOS
+                # truncation at row ends) are NOT counted, so acceptance_rate
+                # slightly undercounts the draft's true hit rate — it is the
+                # rate of draft slots that became useful tokens.
+                sp = stats["spec"]
+                fwds = int(emit[:, :, 0].sum())
+                emitted = int(emit.sum())
+                sp["target_forwards"] += fwds
+                sp["emitted_tokens"] += emitted
+                sp["accepted_drafts"] += emitted - fwds
+                if sp["target_forwards"]:
+                    sp["acceptance_rate"] = round(
+                        sp["accepted_drafts"]
+                        / (gamma * sp["target_forwards"]), 4)
+                    sp["tokens_per_target_forward"] = round(
+                        sp["emitted_tokens"] / sp["target_forwards"], 4)
             emissions, finished = slots.absorb(toks, emit, width)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
                 seq.generated.extend(new_toks)
-                seq.seen_tokens = int(slots.cached_h[slots.slot_of_uid[uid]])
+                # the committed watermark, NOT the speculative write cursor:
+                # rejected draft positions never count as seen
+                seq.seen_tokens = int(
+                    slots.committed_h[slots.slot_of_uid[uid]])
             for uid in finished:
                 seq = self.state.seqs[uid]
                 seq.done = True

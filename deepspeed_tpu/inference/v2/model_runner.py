@@ -26,7 +26,7 @@ import jax.numpy as jnp
 from ...models import layers as L
 from ...models.transformer import CausalLM
 from ...ops.attention import decode_attention
-from ..sampling import sample_logits_per_row
+from ..sampling import sample_logits_per_row, speculative_verify_per_row
 
 
 def _use_pallas_paged() -> bool:
@@ -57,11 +57,14 @@ class PagedModelRunner:
 
         return run
 
-    def _forward(self, params, ids, positions, block_tables, valid_counts, kpool, vpool):
+    def _forward(self, params, ids, positions, block_tables, valid_counts,
+                 kpool, vpool, *, all_logits=False):
         """ids/positions: (B, C); block_tables: (B, MB);
         valid_counts: (B,) number of real (non-pad) tokens in the chunk;
         kpool/vpool: (L, KVH, NB, bs, D). Returns (last_logits (B, V),
-        kpool, vpool)."""
+        kpool, vpool) — or ((B, C, V) logits at EVERY chunk position when
+        ``all_logits`` is set, which is how the speculative verify scores
+        all gamma+1 positions in one batched ragged forward."""
         cfg = self.cfg
         bs = self.block_size
         model = self.model
@@ -173,7 +176,7 @@ class PagedModelRunner:
         h, kpool, vpool = self._run_layers(layer, h, params, kpool, vpool,
                                            windows, blk, off)
         h = L.apply_norm(params["final_norm"], h, cfg)
-        return self._head(params, h, valid_counts), kpool, vpool
+        return self._head(params, h, valid_counts, all_logits), kpool, vpool
 
     def _run_layers(self, layer, h, params, kpool, vpool, windows, blk, off):
         """Drive ``layer`` over the stack following the model's layer plan
@@ -202,17 +205,24 @@ class PagedModelRunner:
         vpool = vpool.at[:, :, blk, off].set(cv_all.transpose(0, 3, 1, 2, 4))
         return h, kpool, vpool
 
-    def _head(self, params, h, valid_counts):
-        """Last-valid-token logits (B, V) from normed hidden states."""
+    def _head(self, params, h, valid_counts, all_logits=False):
+        """Last-valid-token logits (B, V) from normed hidden states — or
+        per-position logits (B, C, V) when ``all_logits`` (the speculative
+        verify needs the target's distribution at every drafted slot)."""
         cfg = self.cfg
         dt = cfg.act_dtype
-        # last valid token of each chunk
-        last_idx = jnp.maximum(valid_counts - 1, 0)
-        h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
-        if cfg.tie_embeddings:
-            logits = jnp.einsum("be,ve->bv", h_last, params["embed"]["tok"].astype(dt))
+        if all_logits:
+            h_last = h                                   # (B, C, E)
+            eq_tied, eq_untied = "bce,ve->bcv", "bce,ev->bcv"
         else:
-            logits = jnp.einsum("be,ev->bv", h_last, params["embed"]["lm_head"].astype(dt))
+            # last valid token of each chunk
+            last_idx = jnp.maximum(valid_counts - 1, 0)
+            h_last = jnp.take_along_axis(h, last_idx[:, None, None], axis=1)[:, 0]
+            eq_tied, eq_untied = "be,ve->bv", "be,ev->bv"
+        if cfg.tie_embeddings:
+            logits = jnp.einsum(eq_tied, h_last, params["embed"]["tok"].astype(dt))
+        else:
+            logits = jnp.einsum(eq_untied, h_last, params["embed"]["lm_head"].astype(dt))
         if "lm_head_bias" in params["embed"]:
             logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
         if cfg.logit_softcap:
@@ -357,22 +367,109 @@ class PagedModelRunner:
             self._fns["frame"] = self._build_frame_loop()
         return self._fns["frame"](*args, **kwargs)
 
+    def _build_frame_loop_spec(self, draft_runner):
+        fwd = self._forward
+        draft_fwd = draft_runner._forward
+
+        @functools.partial(jax.jit,
+                           donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16, 17),
+                           static_argnames=("width", "steps", "greedy", "gamma"))
+        def loop(params, draft_params, prompts, prompt_lens, limits, eos_ids,
+                 temps, tables, cached, produced, last_tok, penult, done, rng,
+                 kpool, vpool, dkpool, dvpool, width, steps, greedy, gamma):
+            """Speculative K-step serving frame: ``frame_loop`` with a second
+            model riding the carry. Wide (prefill) frames run the target body
+            unchanged while the draft ingests the same chunks (its paged KV
+            pools ``dkpool``/``dvpool`` share the target's block tables);
+            pure-decode frames (width 1) run gamma draft proposals + ONE
+            gamma+1-wide target verify per step, with per-row acceptance and
+            rollback as in-graph selects (``_serving_scan_body``) — the host
+            still touches the loop only at frame boundaries.
+
+            Returns (tokens (steps, B, gamma+1), emit (steps, B, gamma+1),
+            new carry...). ``penult`` is the token at position ``cached - 1``
+            per row; the first draft step of each speculative step re-feeds
+            it so the draft cache self-heals after a fully-accepted step
+            without a separate catch-up forward."""
+            body = _serving_scan_body(fwd, params, prompts, prompt_lens,
+                                      limits, eos_ids, temps, tables, width,
+                                      greedy,
+                                      draft=(draft_fwd, draft_params, gamma))
+            carry = (cached, produced, last_tok, penult, done, rng,
+                     kpool, vpool, dkpool, dvpool)
+            carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
+            return (toks, emit) + carry
+
+        return loop
+
+    def frame_loop_spec(self, draft_runner, *args, **kwargs):
+        if "spec_frame" not in self._fns:
+            self._fns["spec_frame"] = self._build_frame_loop_spec(draft_runner)
+        return self._fns["spec_frame"](*args, **kwargs)
+
+    def _build_mixed_loop_spec(self, draft_runner):
+        fwd = self._forward
+        draft_fwd = draft_runner._forward
+
+        @functools.partial(jax.jit, donate_argnums=(5, 6, 7, 8),
+                           static_argnames=("chunk", "wide_steps",
+                                            "narrow_steps", "greedy", "gamma"))
+        def loop(params, draft_params, prompts, prompt_lens, new_limits,
+                 kpool, vpool, dkpool, dvpool, block_tables, rng, temperature,
+                 chunk, wide_steps, narrow_steps, greedy, gamma):
+            """``mixed_loop`` with speculation: the wide scan prefills both
+            models, the narrow scan runs draft/verify speculative steps —
+            rows freeze at their limits, so ``narrow_steps`` stays the
+            worst-case (no-acceptance) budget and early finishers coast.
+            Returns tokens/emit shaped (steps, B, gamma+1)."""
+            b = prompts.shape[0]
+            no_eos = jnp.full((b,), -1, jnp.int32)
+            temps = jnp.full((b,), temperature, jnp.float32)
+
+            def make_body(width):
+                return _serving_scan_body(fwd, params, prompts, prompt_lens,
+                                          new_limits, no_eos, temps,
+                                          block_tables, width, greedy,
+                                          draft=(draft_fwd, draft_params,
+                                                 gamma))
+
+            zero = jnp.zeros((b,), jnp.int32)
+            carry = (zero, zero, zero, zero, jnp.zeros((b,), bool), rng,
+                     kpool, vpool, dkpool, dvpool)
+            carry, (toks_w, emit_w) = jax.lax.scan(
+                make_body(chunk), carry, None, length=wide_steps)
+            carry, (toks_n, emit_n) = jax.lax.scan(
+                make_body(1), carry, None, length=narrow_steps)
+            return (jnp.concatenate([toks_w, toks_n]),
+                    jnp.concatenate([emit_w, emit_n]),
+                    carry[6], carry[7], carry[8], carry[9])
+
+        return loop
+
+    def mixed_loop_spec(self, draft_runner, *args, **kwargs):
+        if "spec_mixed" not in self._fns:
+            self._fns["spec_mixed"] = self._build_mixed_loop_spec(draft_runner)
+        return self._fns["spec_mixed"](*args, **kwargs)
+
     def run(self, chunk: int, *args):
         if chunk not in self._fns:
             self._fns[chunk] = self._build(chunk)
         return self._fns[chunk](*args)
 
-    def compile_count(self) -> int:
-        """Total compiled executables across every cached entry point —
-        each jitted wrapper retraces per distinct arg shape/static combo,
-        so this is the real program count (the recompile-budget tests
-        assert it stays O(log) in batch size / table width)."""
-        return sum(f._cache_size() for f in self._fns.values()
-                   if hasattr(f, "_cache_size"))
+    def compile_count(self) -> dict:
+        """Compiled-executable count PER entry point: each jitted wrapper
+        retraces per distinct arg shape/static combo, so these are the real
+        program counts (the recompile-budget tests pin the function that
+        recompiled instead of asserting one aggregate). Keys: "frame",
+        "mixed", "loop", "spec_frame", "spec_mixed", and "chunk<W>" for the
+        per-chunk ``run`` programs; ``sum(compile_count().values())`` is the
+        old aggregate."""
+        return {(f"chunk{k}" if isinstance(k, int) else str(k)): f._cache_size()
+                for k, f in self._fns.items() if hasattr(f, "_cache_size")}
 
 
 def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
-                       temps, tables, width, greedy):
+                       temps, tables, width, greedy, draft=None):
     """Shared scan-step for ``mixed_loop`` and ``frame_loop`` — the in-graph
     SplitFuse scheduling arithmetic lives in exactly one place.
 
@@ -383,25 +480,30 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
     width 0, positions -1, which the pager routes to the trash block.
     ``eos_ids``/``temps`` are per-row; pass eos_ids = -1 for "no EOS" (token
     ids are never negative) and uniform temps for scalar-temperature callers.
-    Emits (token-or--1, emit-mask) per step."""
-    offs = jnp.arange(width)
+    Emits (token-or--1, emit-mask) per step.
+
+    ``draft=(draft_fwd, draft_params, gamma)`` enables speculative decoding:
+    the carry grows (penult, dkpool, dvpool) — inserted after ``last_tok``
+    and after ``vpool`` respectively — and emissions become (B, gamma+1)
+    wide. Wide steps (width > 1) behave exactly as without a draft, except
+    the draft ingests the same chunk so its paged KV tracks the committed
+    prefix. Width-1 steps become speculative: gamma sequential draft
+    proposals, ONE gamma+1-wide target verify, in-graph acceptance
+    (greedy token-match / rejection sampling via
+    ``speculative_verify_per_row``), and rollback as a ``jnp.where`` on the
+    carry — ``cached`` (the per-row committed watermark), ``last_tok``,
+    ``penult`` and the emit masks all select back to the accepted prefix,
+    while rejected target/draft KV entries simply sit beyond the watermark
+    until the next step's writes overwrite them."""
+    if draft is not None:
+        return _spec_scan_body(fwd, params, prompts, prompt_lens, limits,
+                               eos_ids, temps, tables, width, greedy, *draft)
 
     def body(carry, _):
         cached, produced, last_tok, done, rng, kpool, vpool = carry
-        prefilling = cached < prompt_lens
-        active = ~done & (prefilling | (produced < limits))
-        w = jnp.where(
-            active,
-            jnp.where(prefilling,
-                      jnp.minimum(width, prompt_lens - cached), 1),
-            0)
-        idx = jnp.clip(cached[:, None] + offs[None, :], 0,
-                       prompts.shape[1] - 1)
-        ids = jnp.where(prefilling[:, None],
-                        jnp.take_along_axis(prompts, idx, axis=1),
-                        jnp.where(offs[None, :] == 0, last_tok[:, None], 0))
-        mask = offs[None, :] < w[:, None]
-        positions = jnp.where(mask, cached[:, None] + offs[None, :], -1)
+        prefilling, active, w, ids, positions = _wide_plan(
+            prompts, prompt_lens, limits, width, cached, produced, last_tok,
+            done)
         logits, kpool, vpool = fwd(params, ids, positions, tables, w,
                                    kpool, vpool)
         if greedy:
@@ -409,13 +511,191 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         else:
             rng, sub = jax.random.split(rng)
             nxt = sample_logits_per_row(logits, sub, temps)
-        completes = active & prefilling & (cached + w == prompt_lens)
-        emit = completes | (~prefilling & active)
-        last_tok = jnp.where(emit, nxt, last_tok)
-        done = done | (emit & (nxt == eos_ids))
+        emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
+                                          prompt_lens, eos_ids, nxt,
+                                          last_tok, done)
         return ((cached + w, produced + emit.astype(jnp.int32),
                  last_tok, done, rng, kpool, vpool),
                 (jnp.where(emit, nxt, -1), emit))
+
+    return body
+
+
+def _wide_plan(prompts, prompt_lens, limits, width, cached, produced,
+               last_tok, done):
+    """The per-row SplitFuse scheduling arithmetic of a (wide) serving step:
+    who prefills, who decodes, who freezes, and the chunk they consume.
+    Returns (prefilling, active, w, ids, positions); frozen rows get w=0 and
+    positions -1 (trash-routed). Shared by the plain and speculative scan
+    bodies — the host-mirror replay in ``DeviceSlotTable.absorb`` mirrors
+    exactly this arithmetic, so it must not fork."""
+    offs = jnp.arange(width)
+    prefilling = cached < prompt_lens
+    active = ~done & (prefilling | (produced < limits))
+    w = jnp.where(
+        active,
+        jnp.where(prefilling,
+                  jnp.minimum(width, prompt_lens - cached), 1),
+        0)
+    idx = jnp.clip(cached[:, None] + offs[None, :], 0,
+                   prompts.shape[1] - 1)
+    ids = jnp.where(prefilling[:, None],
+                    jnp.take_along_axis(prompts, idx, axis=1),
+                    jnp.where(offs[None, :] == 0, last_tok[:, None], 0))
+    mask = offs[None, :] < w[:, None]
+    positions = jnp.where(mask, cached[:, None] + offs[None, :], -1)
+    return prefilling, active, w, ids, positions
+
+
+def _wide_emit(active, prefilling, cached, w, prompt_lens, eos_ids, nxt,
+               last_tok, done):
+    """Completion/emit bookkeeping of a wide serving step (the other half of
+    ``_wide_plan``'s contract): rows completing their prefill and decode
+    rows emit ``nxt``; EOS freezes in-graph."""
+    completes = active & prefilling & (cached + w == prompt_lens)
+    emit = completes | (~prefilling & active)
+    last_tok = jnp.where(emit, nxt, last_tok)
+    done = done | (emit & (nxt == eos_ids))
+    return emit, last_tok, done
+
+
+def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
+                    temps, tables, width, greedy, draft_fwd, draft_params,
+                    gamma):
+    """Speculative variant of the serving scan step (see
+    ``_serving_scan_body``). Carry: (cached, produced, last_tok, penult,
+    done, rng, kpool, vpool, dkpool, dvpool); emissions are (B, gamma+1).
+
+    Invariants at every step boundary, per row: target KV is committed for
+    positions [0, cached) (``cached`` IS the committed watermark — pool
+    slots at or beyond it may hold rejected speculation and are dead until
+    overwritten); ``last_tok`` sits at position ``cached`` and is not yet in
+    any cache; ``penult`` is the token at position ``cached - 1``; the draft
+    KV is valid for [0, cached - 1] at least (the width-2 first draft step
+    re-feeds ``penult`` + ``last_tok``, which restores the one slot a fully
+    accepted previous step can leave the draft missing — re-writing an
+    already-valid slot reproduces the same KV, since the context below it
+    is committed)."""
+    k_out = gamma + 1
+    koffs = jnp.arange(k_out)
+
+    if width > 1:
+        def body(carry, _):
+            (cached, produced, last_tok, penult, done, rng,
+             kpool, vpool, dkpool, dvpool) = carry
+            b = cached.shape[0]
+            prefilling, active, w, ids, positions = _wide_plan(
+                prompts, prompt_lens, limits, width, cached, produced,
+                last_tok, done)
+            logits, kpool, vpool = fwd(params, ids, positions, tables, w,
+                                       kpool, vpool)
+            # the draft ingests the identical chunk: prefill rows stream the
+            # prompt into the draft pools, decode rows (w=1 inside a wide
+            # mixed frame) keep the draft cache on the committed prefix
+            _, dkpool, dvpool = draft_fwd(draft_params, ids, positions,
+                                          tables, w, dkpool, dvpool)
+            if greedy:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                rng, sub = jax.random.split(rng)
+                nxt = sample_logits_per_row(logits, sub, temps)
+            # token at position (cached + w - 1): last prompt token for rows
+            # completing prefill, the consumed last_tok for decode rows —
+            # snapshot BEFORE _wide_emit overwrites last_tok
+            tail = jnp.take_along_axis(
+                prompts, jnp.maximum(prompt_lens - 1, 0)[:, None],
+                axis=1)[:, 0]
+            new_penult = jnp.where(prefilling, tail, last_tok)
+            emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
+                                              prompt_lens, eos_ids, nxt,
+                                              last_tok, done)
+            penult = jnp.where(emit, new_penult, penult)
+            toks_k = jnp.full((b, k_out), -1, jnp.int32).at[:, 0].set(
+                jnp.where(emit, nxt, -1))
+            emit_k = jnp.zeros((b, k_out), bool).at[:, 0].set(emit)
+            return ((cached + w, produced + emit.astype(jnp.int32), last_tok,
+                     penult, done, rng, kpool, vpool, dkpool, dvpool),
+                    (toks_k, emit_k))
+
+        return body
+
+    # ---- width 1: the speculative decode step ----
+    def body(carry, _):
+        (cached, produced, last_tok, penult, done, rng,
+         kpool, vpool, dkpool, dvpool) = carry
+        # speculative frames are scheduled only when no slot prefills; a
+        # prefilling row here would freeze (serve() never produces one)
+        active = ~done & (cached >= prompt_lens) & (produced < limits)
+        # positions past the row's KV reservation (prompt + budget + 1
+        # lookahead) must route to the trash block — a clipped block-table
+        # gather would otherwise scatter rejected speculation into the
+        # row's LIVE last page. Their logits are garbage but provably never
+        # emitted: index k needs produced + k < limits, which bounds the
+        # position below the cap.
+        cap = prompt_lens + limits
+
+        def pos_of(p):
+            return jnp.where(active[:, None] & (p >= 0) & (p <= cap[:, None]),
+                             p, -1)
+
+        if greedy:
+            draft_rngs = [None] * gamma
+            rng_v = None
+        else:
+            rng, *subs = jax.random.split(rng, gamma + 2)
+            draft_rngs, rng_v = subs[:gamma], subs[gamma]
+
+        def propose(logits, r):
+            if greedy:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return sample_logits_per_row(logits, r, temps)
+
+        # ---- draft phase: gamma proposals; step 0 is width 2 (re-feeds
+        # penult + last_tok, healing the draft cache — see invariants) ----
+        av = active.astype(jnp.int32)
+        ids0 = jnp.stack([penult, last_tok], axis=1)
+        pos0 = pos_of(jnp.stack([cached - 1, cached], axis=1))
+        dlog, dkpool, dvpool = draft_fwd(draft_params, ids0, pos0, tables,
+                                         2 * av, dkpool, dvpool)
+        q = [propose(dlog, draft_rngs[0])]
+        dlogits = [dlog]
+        for j in range(1, gamma):
+            dlog, dkpool, dvpool = draft_fwd(
+                draft_params, q[-1][:, None], pos_of((cached + j)[:, None]),
+                tables, av, dkpool, dvpool)
+            dlogits.append(dlog)
+            q.append(propose(dlog, draft_rngs[j]))
+        q = jnp.stack(q, axis=1)                          # (B, G)
+        dlogits = jnp.stack(dlogits, axis=1)              # (B, G, V)
+
+        # ---- verify: ONE batched ragged target forward over the committed
+        # last token + all gamma drafts ----
+        ids_v = jnp.concatenate([last_tok[:, None], q], axis=1)
+        pos_v = pos_of(cached[:, None] + koffs[None, :])
+        tlogits, kpool, vpool = fwd(params, ids_v, pos_v, tables,
+                                    k_out * av, kpool, vpool, all_logits=True)
+        n_acc, repl = speculative_verify_per_row(tlogits, dlogits, q, temps,
+                                                 rng=rng_v)
+
+        # ---- accept + rollback: pure selects on the carry ----
+        q_pad = jnp.concatenate([q, q[:, -1:]], axis=1)   # (B, G+1)
+        e = jnp.where(koffs[None, :] < n_acc[:, None], q_pad, repl[:, None])
+        is_eos = e == eos_ids[:, None]
+        eos_before = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) - is_eos
+        emit = (active[:, None] & (koffs[None, :] <= n_acc[:, None])
+                & (produced[:, None] + koffs[None, :] < limits[:, None])
+                & (eos_before == 0))
+        m = jnp.sum(emit.astype(jnp.int32), axis=1)
+        seq_toks = jnp.concatenate([last_tok[:, None], e], axis=1)
+        new_last = jnp.take_along_axis(seq_toks, m[:, None], axis=1)[:, 0]
+        new_penult = jnp.take_along_axis(
+            seq_toks, jnp.maximum(m - 1, 0)[:, None], axis=1)[:, 0]
+        last_tok = jnp.where(active, new_last, last_tok)
+        penult = jnp.where(active, new_penult, penult)
+        done = done | jnp.any(emit & is_eos, axis=1)
+        return ((cached + m, produced + m, last_tok, penult, done, rng,
+                 kpool, vpool, dkpool, dvpool),
+                (jnp.where(emit, e, -1), emit))
 
     return body
 

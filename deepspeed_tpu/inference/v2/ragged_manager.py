@@ -101,6 +101,15 @@ class DeviceSlotTable:
     A free slot is a frozen row: ``done=True, limits=0`` — the frame body
     gives it width 0, its positions go to -1, and the pager routes its
     (masked) writes to the trash block.
+
+    Under speculative serving, ``cached`` doubles as the per-row COMMITTED
+    watermark: a speculative step writes target KV for all gamma+1 verified
+    positions, but the in-graph rollback selects ``cached`` back to the
+    accepted prefix — pool slots at or beyond the watermark may hold
+    rejected speculation and are simply overwritten by the next step's
+    writes (no host-side block surgery). ``penult`` carries the token at
+    position ``cached - 1``, which the draft re-feeds each step to keep its
+    own KV pools on the committed prefix without a catch-up pass.
     """
 
     def __init__(self, n_slots: int, prompt_width: int, table_width: int, rng):
@@ -116,6 +125,7 @@ class DeviceSlotTable:
         self.cached = zi(n_slots)
         self.produced = zi(n_slots)
         self.last_tok = zi(n_slots)
+        self.penult = zi(n_slots)          # speculative carry: token at cached-1
         self.done = jnp.ones((n_slots,), bool)
         self.rng = rng
         # host mirrors — admission control only
@@ -128,6 +138,13 @@ class DeviceSlotTable:
         self.eos_h = np.full((n_slots,), -1, np.int64)
         self.temps_h = np.zeros((n_slots,), np.float64)
         self.done_h = np.ones((n_slots,), bool)
+
+    @property
+    def committed_h(self) -> np.ndarray:
+        """Host mirror of the per-row committed watermark: tokens whose
+        target KV is final (``cached`` — pool slots at or beyond it may hold
+        rejected speculation awaiting overwrite)."""
+        return self.cached_h
 
     # ---------------- host-mirror queries (no device sync) ----------------
 
@@ -211,6 +228,7 @@ class DeviceSlotTable:
         self.cached = self.cached.at[idx].set(zero)
         self.produced = self.produced.at[idx].set(zero)
         self.last_tok = self.last_tok.at[idx].set(zero)
+        self.penult = self.penult.at[idx].set(zero)
         self.done = self.done.at[idx].set(False)
 
     def retire(self, uid: int) -> None:
@@ -225,21 +243,41 @@ class DeviceSlotTable:
     # ---------------- frame execution + host replay ----------------
 
     def run_frame(self, runner, params, kv, width: int, steps: int,
-                  greedy: bool):
+                  greedy: bool, draft=None):
         """Execute one K-step frame and swap the donated carry in place.
-        The only device→host transfer is the (steps, B) token/emit pair."""
-        (toks, emit, self.cached, self.produced, self.last_tok, self.done,
-         self.rng, kv.k, kv.v) = runner.frame_loop(
-            params, self.prompts, self.prompt_lens, self.limits, self.eos_ids,
-            self.temps, self.tables, self.cached, self.produced, self.last_tok,
-            self.done, self.rng, kv.k, kv.v,
-            width=width, steps=steps, greedy=greedy)
+        The only device→host transfer is the (steps, B[, gamma+1])
+        token/emit pair. ``draft=(draft_runner, draft_params, draft_kv,
+        gamma)`` runs the speculative frame: the draft's paged KV pools ride
+        the same donated carry and share this table's block tables."""
+        if draft is None:
+            (toks, emit, self.cached, self.produced, self.last_tok, self.done,
+             self.rng, kv.k, kv.v) = runner.frame_loop(
+                params, self.prompts, self.prompt_lens, self.limits,
+                self.eos_ids, self.temps, self.tables, self.cached,
+                self.produced, self.last_tok, self.done, self.rng, kv.k, kv.v,
+                width=width, steps=steps, greedy=greedy)
+            return np.asarray(toks), np.asarray(emit)
+        draft_runner, draft_params, draft_kv, gamma = draft
+        (toks, emit, self.cached, self.produced, self.last_tok, self.penult,
+         self.done, self.rng, kv.k, kv.v, draft_kv.k,
+         draft_kv.v) = runner.frame_loop_spec(
+            draft_runner, params, draft_params, self.prompts,
+            self.prompt_lens, self.limits, self.eos_ids, self.temps,
+            self.tables, self.cached, self.produced, self.last_tok,
+            self.penult, self.done, self.rng, kv.k, kv.v, draft_kv.k,
+            draft_kv.v, width=width, steps=steps, greedy=greedy,
+            gamma=gamma)
         return np.asarray(toks), np.asarray(emit)
 
     def absorb(self, toks: np.ndarray, emit: np.ndarray, width: int):
         """Replay the frame against the host mirrors (same arithmetic as the
         in-graph body) → ({uid: [tokens emitted this frame]}, [finished uids]).
-        A row finishes when it emits its EOS or reaches its token limit."""
+        A row finishes when it emits its EOS or reaches its token limit.
+        Speculative frames hand in (steps, B, gamma+1) token/emit arrays —
+        the mirrors replay the variable tokens-per-step emit mask exactly,
+        so the committed watermark never needs a device read-back."""
+        if emit.ndim == 3:
+            return self._absorb_spec(toks, emit, width)
         emissions: Dict[int, List[int]] = {}
         finished: List[int] = []
         live = [i for i in range(self.n_slots) if self.uid_of_slot[i] >= 0]
@@ -261,6 +299,48 @@ class DeviceSlotTable:
                     self.produced_h[i] += 1
                     if t == self.eos_h[i] or self.produced_h[i] >= self.limit_h[i]:
                         self.done_h[i] = True
+        for i in live:
+            if self.done_h[i]:
+                finished.append(int(self.uid_of_slot[i]))
+        return emissions, finished
+
+    def _absorb_spec(self, toks: np.ndarray, emit: np.ndarray, width: int):
+        """Speculative replay: a decode row advances its committed watermark
+        by however many tokens its emit row carries (accepted drafts + the
+        bonus/correction token); prefill rows advance by the chunk and emit
+        at most their first token in column 0 — the exact arithmetic of
+        ``_spec_scan_body``."""
+        emissions: Dict[int, List[int]] = {}
+        finished: List[int] = []
+        live = [i for i in range(self.n_slots) if self.uid_of_slot[i] >= 0]
+        for s in range(toks.shape[0]):
+            for i in live:
+                if self.done_h[i]:
+                    continue
+                uid = int(self.uid_of_slot[i])
+                if self.cached_h[i] < self.plen_h[i]:
+                    self.cached_h[i] += min(width,
+                                            self.plen_h[i] - self.cached_h[i])
+                    if emit[s, i, 0]:
+                        t = int(toks[s, i, 0])
+                        emissions.setdefault(uid, []).append(t)
+                        self.produced_h[i] += 1
+                        if (t == self.eos_h[i]
+                                or self.produced_h[i] >= self.limit_h[i]):
+                            self.done_h[i] = True
+                elif self.produced_h[i] < self.limit_h[i]:
+                    m = 0
+                    for k in range(emit.shape[2]):
+                        if not emit[s, i, k]:
+                            continue   # (the mask is a prefix; stay defensive)
+                        t = int(toks[s, i, k])
+                        emissions.setdefault(uid, []).append(t)
+                        m += 1
+                        self.produced_h[i] += 1
+                        if (t == self.eos_h[i]
+                                or self.produced_h[i] >= self.limit_h[i]):
+                            self.done_h[i] = True
+                    self.cached_h[i] += m
         for i in live:
             if self.done_h[i]:
                 finished.append(int(self.uid_of_slot[i]))
