@@ -335,6 +335,19 @@ def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
 
     run_frames()                                      # compile both widths
     f_produced, f_dt, f_dev = run_frames()
+    # telemetry state of the measured run: TTFT/ITL/E2E/queue-wait
+    # percentile summaries ride in the bench JSON
+    telemetry = {
+        "latency_ms": eng.telemetry.latency_ms(),
+        # run-AVERAGE occupancy and run-PEAK KV pressure (the live gauges
+        # hold the near-empty final drain frame's figures, useless for
+        # comparing configurations)
+        "occupancy_avg": eng.telemetry.snapshot()["derived"]["occupancy_avg"],
+        "kv_blocks_in_use_peak":
+            eng.telemetry.gauges["kv_blocks_in_use_peak"],
+        "admission_deferrals": eng.telemetry.counters["admission_deferrals"],
+        "recompiled_programs": eng.runner.compile_count_total(),
+    }
     run_host_steps()                                  # compile
     h_produced, h_dt = run_host_steps()
     return {
@@ -344,6 +357,7 @@ def bench_mixed_dynamic(model_name, batch, prompt_len, new_tokens,
         "frame_steps": frame_steps,
         "frame_tok_per_sec": round(f_produced / f_dt, 1),
         "sched_overhead_pct": round(100 * (f_dt - f_dev) / f_dt, 2),
+        "telemetry": telemetry,
         "host_step_tok_per_sec": round(h_produced / h_dt, 1),
         "frame_speedup": round((f_produced / f_dt) / (h_produced / h_dt), 2),
         "note": "same Poisson schedule for both loops; frame_tok_per_sec is "
@@ -411,6 +425,107 @@ def bench_mixed_dynamic_spec(model_name, batch, prompt_len, new_tokens,
                 "real serving scales with (1 + acceptance*gamma) / "
                 "(1 + gamma*draft_cost_ratio)",
     }
+
+
+def bench_telemetry_overhead(model_name, batch, prompt_len, new_tokens,
+                             n_arrivals=16, repeats=5, assert_budget=False):
+    """Telemetry-on vs telemetry-off serving throughput on an IDENTICAL
+    deterministic arrival schedule (one arrival per frame-boundary poll — no
+    wall clock, so both modes see byte-identical admission timing).
+
+    The in-graph counters are always compiled into the frame, so the delta
+    isolates exactly the host stats path this PR adds: the per-frame counter
+    sync, lifecycle histograms, and view updates. ``repeats`` paired rounds
+    in balanced order; the reported overhead is the geometric mean of the
+    per-order median on/off ratios (see the inline measurement notes). In
+    the smoke configuration (``assert_budget=True``) the run FAILS if that
+    estimate exceeds 2% — the telemetry budget is a tested contract, not an
+    aspiration."""
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, 1000, (prompt_len,)).astype(np.int32)
+               for _ in range(n_arrivals)]
+
+    def run_once(eng):
+        def arrivals():
+            for u, p in enumerate(prompts):
+                yield [(u, p)]
+        produced = 0
+        t0 = time.perf_counter()
+        for _uid, toks in eng.serve(arrivals(), max_new_tokens=new_tokens):
+            produced += len(toks)
+        return produced, time.perf_counter() - t0
+
+    # both modes on ONE engine (identical compiled programs — the in-graph
+    # counters are always part of the frame), measured as PAIRED rounds:
+    # each round times on and off back to back and contributes one on/off
+    # ratio, so box-wide slowdowns (shared-CPU noise dwarfs the µs-scale
+    # host stats path at smoke size) hit both halves alike and cancel.
+    # Rounds run in BALANCED order (half on-first, half off-first) because
+    # the first serve after a mode switch pays a measurable cache penalty
+    # on a contended box; the geometric mean of the two per-order medians
+    # cancels that bias, which a single median over alternating rounds
+    # does not (odd counts leave one order over-represented).
+    eng = _mk_engine(model_name, batch,
+                     expected_context=prompt_len + new_tokens)
+    run_once(eng)                                     # compile
+    ratios = {("on", "off"): [], ("off", "on"): []}
+    best = {"on": 1e9, "off": 1e9}
+    produced = 0
+
+    def measure_rounds(n):
+        nonlocal produced
+        for r in range(n):
+            dts = {}
+            order = ("on", "off") if r % 2 == 0 else ("off", "on")
+            for mode in order:
+                eng.telemetry.enabled = mode == "on"
+                produced, dts[mode] = run_once(eng)
+                best[mode] = min(best[mode], dts[mode])
+            ratios[order].append(dts["on"] / dts["off"])
+
+    def estimate():
+        meds = [statistics.median(v) for v in ratios.values() if v]
+        g = 1.0
+        for m in meds:
+            g *= m
+        return 100 * (g ** (1.0 / len(meds)) - 1.0)
+
+    rounds = 2 * ((repeats + 1) // 2)                 # round UP to balanced
+    measure_rounds(rounds)
+    # one retry pass absorbs a fully contended measurement window before
+    # the smoke assert fires (fresh rounds fold into the medians)
+    if assert_budget and estimate() >= 2.0:
+        measure_rounds(rounds)
+    eng.telemetry.enabled = True
+    run_once(eng)                                     # telemetry for the row
+    tel_summary = eng.telemetry.latency_ms()
+    results = {m: {"tok_per_sec": round(produced / b, 1),
+                   "best_s": round(b, 4)} for m, b in best.items()}
+    all_ratios = [r for v in ratios.values() for r in v]
+    overhead_pct = round(estimate(), 2)
+    overhead_pct_min = round(100 * (min(all_ratios) - 1.0), 2)
+    row = {
+        "workload": "telemetry-overhead", "batch": batch,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "arrivals": n_arrivals, "repeats": repeats,
+        "paired_rounds_run": len(all_ratios),   # may exceed repeats (retry)
+        "telemetry_on_tok_per_sec": results["on"]["tok_per_sec"],
+        "telemetry_off_tok_per_sec": results["off"]["tok_per_sec"],
+        "overhead_pct": overhead_pct,
+        "overhead_pct_min": overhead_pct_min,
+        "within_2pct_budget": overhead_pct < 2.0,
+        "latency_ms": tel_summary,
+        "note": "same deterministic schedule both modes; in-graph counters "
+                "are compiled in regardless, so this is the host stats "
+                "path alone. overhead_pct = geometric mean of the "
+                "per-order median paired on/off ratios (cancels both "
+                "box-wide noise and first-runner bias); overhead_pct_min "
+                "is the single cleanest round",
+    }
+    if assert_budget:
+        assert overhead_pct < 2.0, \
+            f"telemetry overhead {overhead_pct}% exceeds the 2% budget: {row}"
+    return row
 
 
 def bench_mixed_compiled(model_name, batch, prompt_lens, new_tokens):
@@ -654,6 +769,10 @@ def main():
     b, p, n, arr = mixed_dynamic
     guarded("mixed-splitfuse-dynamic", bench_mixed_dynamic, model, b, p, n,
             n_arrivals=arr)
+    # telemetry budget: the <2% overhead contract is ASSERTED in the smoke
+    # configuration (deterministic schedule, CPU) and reported on TPU
+    guarded("telemetry-overhead", bench_telemetry_overhead, model, b, p, n,
+            n_arrivals=arr, assert_budget=(platform != "tpu"))
     guarded("kernel-delta", bench_kernel_delta, model, *delta)
     if delta_long is not None:
         guarded("kernel-delta", bench_kernel_delta, model, *delta_long)
@@ -673,6 +792,12 @@ def main():
         "value": best_decode, "unit": "decode tokens/s",
         "rows": rows,
     }))
+    # the telemetry <2% overhead budget is a hard contract in the smoke
+    # configuration: guarded() keeps the JSON complete, but a budget breach
+    # must still fail the run (a swallowed assert is not an assert)
+    if any(r.get("workload") == "telemetry-overhead"
+           and r.get("error_type") == "AssertionError" for r in rows):
+        sys.exit(1)
 
 
 if __name__ == "__main__":

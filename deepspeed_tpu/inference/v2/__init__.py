@@ -1,0 +1,6 @@
+"""FastGen-analog ragged serving engine (paged KV, SplitFuse, frame loop).
+
+The telemetry surface is re-exported here so serving front-ends can build
+scrape endpoints without reaching into module internals."""
+
+from .telemetry import LogBucketHistogram, ServingTelemetry  # noqa: F401

@@ -30,6 +30,7 @@ from ..sampling import sample_logits
 from .kv_cache import BlockedKVCache
 from .model_runner import PagedModelRunner
 from .ragged_manager import DeviceSlotTable, DSStateManager
+from .telemetry import ServingTelemetry
 
 
 @dataclasses.dataclass
@@ -68,6 +69,18 @@ class RaggedInferenceEngineConfig:
     # 1 + acceptance * gamma, so larger gammas only pay off with a strong
     # draft (see README "Speculative decoding on the frame carry").
     speculate_gamma: int = 2
+    # serving telemetry (README "Serving telemetry"): False switches off the
+    # HOST side only (per-frame counter sync, latency histograms, monitor
+    # fan-out) — the in-graph counters are always compiled in, so toggling
+    # never retraces a frame program, and the rate-limited overload-deferral
+    # warning stays on (losing the overload signal is the failure mode
+    # telemetry exists to fix). serving_bench.py pins the host path at
+    # < 2% throughput overhead.
+    telemetry: bool = True
+    # wrap every frame in a named jax.profiler.TraceAnnotation so device
+    # profiles line up with the request spans (opt-in: annotations cost a
+    # little host time per frame even with no profiler attached)
+    telemetry_trace: bool = False
     dtype: str = "bfloat16"
 
 
@@ -111,7 +124,8 @@ class InferenceEngineV2:
         self.draft_params = None
         self.draft_runner = None
         self.draft_kv = None
-        self.serve_stats: Dict = {}
+        self.telemetry = ServingTelemetry(enabled=c.telemetry,
+                                          trace=c.telemetry_trace)
         if draft_model is not None:
             self.attach_draft(draft_model, draft_params)
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{bs} "
@@ -171,11 +185,26 @@ class InferenceEngineV2:
                                              self.max_blocks_per_seq)
         # the speculative loops close over the draft runner's _forward: a
         # re-attach must evict them or the old draft would keep running
-        self.runner._fns.pop("spec_frame", None)
-        self.runner._fns.pop("spec_mixed", None)
+        # (evict() folds their programs into the monotonic compile total)
+        self.runner.evict("spec_frame", "spec_mixed")
         log_dist(f"InferenceEngineV2: draft attached "
                  f"(layers={dcfg.num_layers} gamma={c.speculate_gamma})",
                  ranks=[0])
+
+    @property
+    def serve_stats(self) -> Dict:
+        """Thin read-through view over the telemetry subsystem — the dict
+        shape the pre-telemetry serve() exposed (frames, frame_steps_hist,
+        arrival_ewma, spec acceptance counters), now fed from the in-graph
+        frame counters. Full detail: ``engine.telemetry.snapshot()`` /
+        ``engine.telemetry.render_prometheus()``."""
+        return self.telemetry.serve_view
+
+    def attach_monitor(self, monitor, every_frames: int = 1) -> None:
+        """Fan serving telemetry out through a ``MonitorMaster`` (or any
+        object with ``write_events([(tag, value, step)])``) at frame
+        boundaries — the serving twin of the training engine's monitor."""
+        self.telemetry.attach_monitor(monitor, every_frames=every_frames)
 
     # ------------------------------------------------------------------
     # admission control (reference engine_v2.py:184)
@@ -573,14 +602,9 @@ class InferenceEngineV2:
         slots = DeviceSlotTable(
             n_slots, prompt_width=c.prefill_chunk_size,
             table_width=1, rng=frame_rng)
-        self.serve_stats = {
-            "frames": 0, "frame_steps_last": None, "frame_steps_hist": {},
-            "arrival_ewma": 0.0, "adaptive_frame_steps": adaptive,
-            "spec": {"gamma": gamma if speculate else 0, "target_forwards": 0,
-                     "emitted_tokens": 0, "accepted_drafts": 0,
-                     "acceptance_rate": None,
-                     "tokens_per_target_forward": None},
-        }
+        self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
+                                   adaptive=adaptive, n_slots=n_slots,
+                                   kv_blocks_total=self.kv.num_blocks)
         return self._serve_guarded(slots, arrivals, steps, max_new_tokens,
                                    temperature, eos_token_id, speculate,
                                    gamma, adaptive)
@@ -626,10 +650,11 @@ class InferenceEngineV2:
                     temperature, eos_token_id, speculate=False, gamma=0,
                     adaptive=False):
         c = self._config
-        stats = self.serve_stats
+        tel = self.telemetry
         alpha = c.frame_steps_ewma_alpha
         ewma = 0.0
         exhausted = False
+        stats_synced = True     # device stat vector starts at zero
         while True:
             if exhausted:
                 batch = None
@@ -676,6 +701,7 @@ class InferenceEngineV2:
                             f"{clamped}")
                         limit = clamped
                     pending.append((uid, toks, limit, temp, eos))
+                    tel.on_enqueue(uid)
             # ---- admission control (FIFO; blocks reserved for the whole
             # prompt + generation budget up front, so block tables never
             # grow mid-flight) ----
@@ -693,6 +719,17 @@ class InferenceEngineV2:
                 pending.popleft()
                 seq.done = False
                 admits.append((uid, seq, toks, limit, temp, eos))
+                tel.on_admit(uid)
+            if pending:
+                # overload is otherwise invisible: the deferred arrivals
+                # just wait in FIFO order — count it and warn (rate-limited).
+                # admit() hasn't executed yet, so subtract this round's
+                # admits or a full table would be misreported as KV pressure
+                tel.on_defer(
+                    queue_depth=len(pending),
+                    frame_steps=tel.serve_view["frame_steps_last"] or steps,
+                    free_slots=slots.free_slots() - len(admits),
+                    free_blocks=self.kv.free_blocks)
             if admits:
                 slots.ensure_widths(
                     max(len(a[2]) for a in admits),
@@ -711,37 +748,42 @@ class InferenceEngineV2:
             if adaptive:
                 cur_steps = self._pick_frame_steps(
                     ewma, steps, slots.free_slots() == 0)
-            stats["arrival_ewma"] = round(ewma, 4)
-            stats["frame_steps_last"] = cur_steps
-            stats["frame_steps_hist"][cur_steps] = \
-                stats["frame_steps_hist"].get(cur_steps, 0) + 1
-            stats["frames"] += 1
             draft = None
             if speculate:
                 draft = (self.draft_runner, self.draft_params, self.draft_kv,
                          gamma)
-            toks, emit = slots.run_frame(self.runner, self.params, self.kv,
-                                         width, cur_steps, slots.all_greedy(),
-                                         draft=draft)
-            if speculate and width == 1:
-                # column 0 of the emit mask marks an active row-step — i.e.
-                # one target verify forward; extra columns are accepted
-                # drafts. Accepted-but-not-emitted drafts (budget/EOS
-                # truncation at row ends) are NOT counted, so acceptance_rate
-                # slightly undercounts the draft's true hit rate — it is the
-                # rate of draft slots that became useful tokens.
-                sp = stats["spec"]
-                fwds = int(emit[:, :, 0].sum())
-                emitted = int(emit.sum())
-                sp["target_forwards"] += fwds
-                sp["emitted_tokens"] += emitted
-                sp["accepted_drafts"] += emitted - fwds
-                if sp["target_forwards"]:
-                    sp["acceptance_rate"] = round(
-                        sp["accepted_drafts"]
-                        / (gamma * sp["target_forwards"]), 4)
-                    sp["tokens_per_target_forward"] = round(
-                        sp["emitted_tokens"] / sp["target_forwards"], 4)
+            with tel.frame_trace(width, cur_steps):
+                toks, emit = slots.run_frame(
+                    self.runner, self.params, self.kv, width, cur_steps,
+                    slots.all_greedy(), draft=draft)
+            # the in-graph counters replay the old host arithmetic exactly
+            # (verify forwards = emit column 0; accepted drafts = the rest;
+            # accepted-but-not-emitted drafts at budget/EOS truncation are
+            # NOT counted, so acceptance_rate is the rate of draft slots
+            # that became useful tokens). One tiny frame-BOUNDARY read.
+            # The disabled path must stay the true zero-stats baseline, so
+            # even the argument gathering (counter sync, compile totals,
+            # mirror scans) is gated, not just the absorption.
+            if tel.enabled and stats_synced:
+                tel.on_frame(
+                    delta=slots.stats_delta(),
+                    width=width, steps=cur_steps,
+                    live_slots=slots.live_count(),
+                    kv_blocks_in_use=self.kv.num_blocks - self.kv.free_blocks,
+                    arrival_ewma=ewma,
+                    recompiled_programs=self.runner.compile_count_total(),
+                    queue_depth=len(pending))
+            elif tel.enabled:
+                # telemetry re-enabled mid-serve: the device vector holds
+                # the whole disabled-period backlog (possibly int32-wrapped,
+                # and this frame's events are mixed into it) — rebase and
+                # discard; counters only count frames measured while enabled
+                slots.stats_delta()
+                tel.frame_view_update(width, cur_steps, ewma)
+                stats_synced = True
+            else:
+                tel.frame_view_update(width, cur_steps, ewma)
+                stats_synced = False
             emissions, finished = slots.absorb(toks, emit, width)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
@@ -750,12 +792,14 @@ class InferenceEngineV2:
                 # rejected draft positions never count as seen
                 seq.seen_tokens = int(
                     slots.committed_h[slots.slot_of_uid[uid]])
+                tel.on_emit(uid, len(new_toks))
             for uid in finished:
                 seq = self.state.seqs[uid]
                 seq.done = True
                 out = np.asarray(seq.generated, np.int64)
                 slots.retire(uid)
                 self.state.flush_sequence(uid)
+                tel.on_retire(uid)
                 yield uid, out
 
     def serialize(self, path: str):

@@ -27,6 +27,7 @@ from ...models import layers as L
 from ...models.transformer import CausalLM
 from ...ops.attention import decode_attention
 from ..sampling import sample_logits_per_row, speculative_verify_per_row
+from .telemetry import N_STATS   # in-graph frame-counter vector layout
 
 
 def _use_pallas_paged() -> bool:
@@ -47,6 +48,11 @@ class PagedModelRunner:
         self.block_size = block_size
         self.max_blocks = max_blocks_per_seq
         self._fns = {}
+        # compiled programs that lived in since-evicted entry points (e.g.
+        # the spec loops dropped by a draft re-attach): keeps the monotonic
+        # total honest when _fns entries disappear
+        self._evicted_programs = 0
+        self._compile_base = 0
 
     def _build(self, chunk: int):
         fwd = self._forward
@@ -310,13 +316,13 @@ class PagedModelRunner:
                                           block_tables, width, greedy)
 
             zero = jnp.zeros((b,), jnp.int32)
-            carry = (zero, zero, zero, jnp.zeros((b,), bool), rng, kpool,
-                     vpool)
+            carry = (zero, zero, zero, jnp.zeros((b,), bool),
+                     jnp.zeros((N_STATS,), jnp.int32), rng, kpool, vpool)
             carry, (toks_w, emit_w) = jax.lax.scan(
                 make_body(chunk), carry, None, length=wide_steps)
             carry, (toks_n, emit_n) = jax.lax.scan(
                 make_body(1), carry, None, length=narrow_steps)
-            kpool, vpool = carry[5], carry[6]
+            kpool, vpool = carry[6], carry[7]
             return (jnp.concatenate([toks_w, toks_n]),
                     jnp.concatenate([emit_w, emit_n]), kpool, vpool)
 
@@ -330,10 +336,11 @@ class PagedModelRunner:
     def _build_frame_loop(self):
         fwd = self._forward
 
-        @functools.partial(jax.jit, donate_argnums=(7, 8, 9, 10, 11, 12, 13),
+        @functools.partial(jax.jit,
+                           donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14),
                            static_argnames=("width", "steps", "greedy"))
         def loop(params, prompts, prompt_lens, limits, eos_ids, temps, tables,
-                 cached, produced, last_tok, done, rng, kpool, vpool,
+                 cached, produced, last_tok, done, stats, rng, kpool, vpool,
                  width, steps, greedy):
             """One K-step serving FRAME: the resumable generalization of
             ``mixed_loop``. All per-slot state is carry-IN/carry-OUT, so the
@@ -351,12 +358,16 @@ class PagedModelRunner:
 
             Returns (tokens (steps, B), emit (steps, B), new carry...). All
             carry arrays + pools are donated: the frame updates them in
-            place and the outputs ARE the next frame's inputs.
+            place and the outputs ARE the next frame's inputs. ``stats`` is
+            the (N_STATS,) in-graph telemetry accumulator — monotonically
+            increasing device counters that surface only at frame
+            boundaries (see ``telemetry.py``).
             """
             body = _serving_scan_body(fwd, params, prompts, prompt_lens,
                                       limits, eos_ids, temps, tables, width,
                                       greedy)
-            carry = (cached, produced, last_tok, done, rng, kpool, vpool)
+            carry = (cached, produced, last_tok, done, stats, rng, kpool,
+                     vpool)
             carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
             return (toks, emit) + carry
 
@@ -372,11 +383,13 @@ class PagedModelRunner:
         draft_fwd = draft_runner._forward
 
         @functools.partial(jax.jit,
-                           donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16, 17),
+                           donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16,
+                                           17, 18),
                            static_argnames=("width", "steps", "greedy", "gamma"))
         def loop(params, draft_params, prompts, prompt_lens, limits, eos_ids,
-                 temps, tables, cached, produced, last_tok, penult, done, rng,
-                 kpool, vpool, dkpool, dvpool, width, steps, greedy, gamma):
+                 temps, tables, cached, produced, last_tok, penult, done,
+                 stats, rng, kpool, vpool, dkpool, dvpool, width, steps,
+                 greedy, gamma):
             """Speculative K-step serving frame: ``frame_loop`` with a second
             model riding the carry. Wide (prefill) frames run the target body
             unchanged while the draft ingests the same chunks (its paged KV
@@ -395,7 +408,7 @@ class PagedModelRunner:
                                       limits, eos_ids, temps, tables, width,
                                       greedy,
                                       draft=(draft_fwd, draft_params, gamma))
-            carry = (cached, produced, last_tok, penult, done, rng,
+            carry = (cached, produced, last_tok, penult, done, stats, rng,
                      kpool, vpool, dkpool, dvpool)
             carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
             return (toks, emit) + carry
@@ -434,7 +447,8 @@ class PagedModelRunner:
                                                  gamma))
 
             zero = jnp.zeros((b,), jnp.int32)
-            carry = (zero, zero, zero, zero, jnp.zeros((b,), bool), rng,
+            carry = (zero, zero, zero, zero, jnp.zeros((b,), bool),
+                     jnp.zeros((N_STATS,), jnp.int32), rng,
                      kpool, vpool, dkpool, dvpool)
             carry, (toks_w, emit_w) = jax.lax.scan(
                 make_body(chunk), carry, None, length=wide_steps)
@@ -442,7 +456,7 @@ class PagedModelRunner:
                 make_body(1), carry, None, length=narrow_steps)
             return (jnp.concatenate([toks_w, toks_n]),
                     jnp.concatenate([emit_w, emit_n]),
-                    carry[6], carry[7], carry[8], carry[9])
+                    carry[7], carry[8], carry[9], carry[10])
 
         return loop
 
@@ -467,20 +481,49 @@ class PagedModelRunner:
         return {(f"chunk{k}" if isinstance(k, int) else str(k)): f._cache_size()
                 for k, f in self._fns.items() if hasattr(f, "_cache_size")}
 
+    def compile_count_total(self) -> int:
+        """MONOTONIC total of compiled programs (recompiles are the #1
+        silent perf cliff — this is the number to alarm on). Unlike
+        ``sum(compile_count().values())`` it never decreases when an entry
+        point is evicted (``evict``); ``reset_compile_count`` rebases it to
+        zero so a caller can count recompiles per serving window."""
+        cur = self._evicted_programs + sum(
+            f._cache_size() for f in self._fns.values()
+            if hasattr(f, "_cache_size"))
+        return cur - self._compile_base
+
+    def reset_compile_count(self) -> None:
+        """Rebase ``compile_count_total`` to zero (per-window counting)."""
+        self._compile_base = self._evicted_programs + sum(
+            f._cache_size() for f in self._fns.values()
+            if hasattr(f, "_cache_size"))
+
+    def evict(self, *names) -> None:
+        """Drop entry points (a draft re-attach must evict the spec loops
+        that closed over the old draft), folding their program counts into
+        the monotonic total first."""
+        for name in names:
+            f = self._fns.pop(name, None)
+            if f is not None and hasattr(f, "_cache_size"):
+                self._evicted_programs += f._cache_size()
+
 
 def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                        temps, tables, width, greedy, draft=None):
     """Shared scan-step for ``mixed_loop`` and ``frame_loop`` — the in-graph
     SplitFuse scheduling arithmetic lives in exactly one place.
 
-    Carry: (cached, produced, last_tok, done, rng, kpool, vpool). Per step, a
+    Carry: (cached, produced, last_tok, done, stats, rng, kpool, vpool). Per
+    step, a
     row with ``cached < prompt_lens`` prefills (consumes up to ``width``
     prompt tokens); a row past its prompt with ``produced < limits`` decodes
     one token; ``done`` rows (in-graph EOS) and rows at their limit freeze —
     width 0, positions -1, which the pager routes to the trash block.
     ``eos_ids``/``temps`` are per-row; pass eos_ids = -1 for "no EOS" (token
     ids are never negative) and uniform temps for scalar-temperature callers.
-    Emits (token-or--1, emit-mask) per step.
+    Emits (token-or--1, emit-mask) per step. The carry's ``stats`` vector
+    (``telemetry.N_STATS``) accumulates the in-graph frame counters — a few
+    scalar reductions per step, surfaced only at frame boundaries.
 
     ``draft=(draft_fwd, draft_params, gamma)`` enables speculative decoding:
     the carry grows (penult, dkpool, dvpool) — inserted after ``last_tok``
@@ -500,7 +543,7 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                                eos_ids, temps, tables, width, greedy, *draft)
 
     def body(carry, _):
-        cached, produced, last_tok, done, rng, kpool, vpool = carry
+        cached, produced, last_tok, done, stats, rng, kpool, vpool = carry
         prefilling, active, w, ids, positions = _wide_plan(
             prompts, prompt_lens, limits, width, cached, produced, last_tok,
             done)
@@ -514,11 +557,29 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
                                           prompt_lens, eos_ids, nxt,
                                           last_tok, done)
+        stats = stats + _stat_delta(
+            emitted=emit, active=active,
+            prefill_toks=jnp.where(prefilling, w, 0),
+            eos=emit & (nxt == eos_ids),
+            target_fwd=active & ~prefilling)
         return ((cached + w, produced + emit.astype(jnp.int32),
-                 last_tok, done, rng, kpool, vpool),
+                 last_tok, done, stats, rng, kpool, vpool),
                 (jnp.where(emit, nxt, -1), emit))
 
     return body
+
+
+def _stat_delta(emitted=None, active=None, prefill_toks=None, eos=None,
+                target_fwd=None, drafted=None, accepted=None):
+    """One step's (N_STATS,) in-graph counter increment. Each keyword is a
+    bool mask / int array to sum, or None for zero — the layout is pinned by
+    the STAT_* indices in ``telemetry.py`` and the host-mirror replay tests
+    assert the resulting totals exactly."""
+    vals = [emitted, active, prefill_toks, eos, target_fwd, drafted, accepted]
+    z = jnp.zeros((), jnp.int32)
+    out = [z if v is None else jnp.sum(v.astype(jnp.int32)) for v in vals]
+    assert len(out) == N_STATS
+    return jnp.stack(out)
 
 
 def _wide_plan(prompts, prompt_lens, limits, width, cached, produced,
@@ -564,7 +625,8 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                     gamma):
     """Speculative variant of the serving scan step (see
     ``_serving_scan_body``). Carry: (cached, produced, last_tok, penult,
-    done, rng, kpool, vpool, dkpool, dvpool); emissions are (B, gamma+1).
+    done, stats, rng, kpool, vpool, dkpool, dvpool); emissions are
+    (B, gamma+1).
 
     Invariants at every step boundary, per row: target KV is committed for
     positions [0, cached) (``cached`` IS the committed watermark — pool
@@ -581,7 +643,7 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
 
     if width > 1:
         def body(carry, _):
-            (cached, produced, last_tok, penult, done, rng,
+            (cached, produced, last_tok, penult, done, stats, rng,
              kpool, vpool, dkpool, dvpool) = carry
             b = cached.shape[0]
             prefilling, active, w, ids, positions = _wide_plan(
@@ -613,15 +675,23 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
             toks_k = jnp.full((b, k_out), -1, jnp.int32).at[:, 0].set(
                 jnp.where(emit, nxt, -1))
             emit_k = jnp.zeros((b, k_out), bool).at[:, 0].set(emit)
+            # TARGET_FWD stays 0 on wide speculative steps: serve_stats'
+            # speculative accounting counts VERIFY forwards only (decode
+            # rows coasting inside a wide mixed frame are plain decode),
+            # and the device counters must replay that arithmetic exactly
+            stats = stats + _stat_delta(
+                emitted=emit, active=active,
+                prefill_toks=jnp.where(prefilling, w, 0),
+                eos=emit & (nxt == eos_ids))
             return ((cached + w, produced + emit.astype(jnp.int32), last_tok,
-                     penult, done, rng, kpool, vpool, dkpool, dvpool),
+                     penult, done, stats, rng, kpool, vpool, dkpool, dvpool),
                     (toks_k, emit_k))
 
         return body
 
     # ---- width 1: the speculative decode step ----
     def body(carry, _):
-        (cached, produced, last_tok, penult, done, rng,
+        (cached, produced, last_tok, penult, done, stats, rng,
          kpool, vpool, dkpool, dvpool) = carry
         # speculative frames are scheduled only when no slot prefills; a
         # prefilling row here would freeze (serve() never produces one)
@@ -693,7 +763,14 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         last_tok = jnp.where(active, new_last, last_tok)
         penult = jnp.where(active, new_penult, penult)
         done = done | jnp.any(emit & is_eos, axis=1)
-        return ((cached + m, produced + m, last_tok, penult, done, rng,
+        # verify forwards == active rows (column 0 of the emit mask); the
+        # accepted-draft count is the emit columns past it — the device-side
+        # twin of the host arithmetic serve_stats always used
+        stats = stats + _stat_delta(
+            emitted=emit, active=active, eos=emit & is_eos,
+            target_fwd=active, drafted=gamma * active.astype(jnp.int32),
+            accepted=emit[:, 1:])
+        return ((cached + m, produced + m, last_tok, penult, done, stats, rng,
                  kpool, vpool, dkpool, dvpool),
                 (jnp.where(emit, e, -1), emit))
 

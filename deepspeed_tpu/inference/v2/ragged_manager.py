@@ -14,6 +14,8 @@ from typing import Dict, List, Tuple
 import jax.numpy as jnp
 import numpy as np
 
+from .telemetry import zero_stats
+
 
 @dataclasses.dataclass
 class DSSequenceDescriptor:
@@ -128,6 +130,11 @@ class DeviceSlotTable:
         self.penult = zi(n_slots)          # speculative carry: token at cached-1
         self.done = jnp.ones((n_slots,), bool)
         self.rng = rng
+        # in-graph telemetry counters (telemetry.N_STATS): accumulate on the
+        # donated carry; the host reads AND rebases them only at frame
+        # boundaries (stats_delta), so the int32 lanes can never wrap
+        # within one read window
+        self.stats = zero_stats()
         # host mirrors — admission control only
         self.uid_of_slot = np.full((n_slots,), -1, np.int64)
         self.slot_of_uid: Dict[int, int] = {}
@@ -242,32 +249,58 @@ class DeviceSlotTable:
 
     # ---------------- frame execution + host replay ----------------
 
-    def run_frame(self, runner, params, kv, width: int, steps: int,
-                  greedy: bool, draft=None):
-        """Execute one K-step frame and swap the donated carry in place.
-        The only device→host transfer is the (steps, B[, gamma+1])
-        token/emit pair. ``draft=(draft_runner, draft_params, draft_kv,
-        gamma)`` runs the speculative frame: the draft's paged KV pools ride
-        the same donated carry and share this table's block tables."""
+    def dispatch_frame(self, runner, params, kv, width: int, steps: int,
+                       greedy: bool, draft=None):
+        """Dispatch one K-step frame and swap the donated carry in place,
+        returning the (tokens, emit) DEVICE arrays — no host transfer
+        happens here (the telemetry transfer-guard test wraps exactly this
+        method). ``draft=(draft_runner, draft_params, draft_kv, gamma)``
+        runs the speculative frame: the draft's paged KV pools ride the same
+        donated carry and share this table's block tables. The in-graph
+        telemetry counters (``self.stats``) ride the carry too and come back
+        as a device array."""
         if draft is None:
             (toks, emit, self.cached, self.produced, self.last_tok, self.done,
-             self.rng, kv.k, kv.v) = runner.frame_loop(
+             self.stats, self.rng, kv.k, kv.v) = runner.frame_loop(
                 params, self.prompts, self.prompt_lens, self.limits,
                 self.eos_ids, self.temps, self.tables, self.cached,
-                self.produced, self.last_tok, self.done, self.rng, kv.k, kv.v,
+                self.produced, self.last_tok, self.done, self.stats,
+                self.rng, kv.k, kv.v,
                 width=width, steps=steps, greedy=greedy)
-            return np.asarray(toks), np.asarray(emit)
+            return toks, emit
         draft_runner, draft_params, draft_kv, gamma = draft
         (toks, emit, self.cached, self.produced, self.last_tok, self.penult,
-         self.done, self.rng, kv.k, kv.v, draft_kv.k,
+         self.done, self.stats, self.rng, kv.k, kv.v, draft_kv.k,
          draft_kv.v) = runner.frame_loop_spec(
             draft_runner, params, draft_params, self.prompts,
             self.prompt_lens, self.limits, self.eos_ids, self.temps,
             self.tables, self.cached, self.produced, self.last_tok,
-            self.penult, self.done, self.rng, kv.k, kv.v, draft_kv.k,
-            draft_kv.v, width=width, steps=steps, greedy=greedy,
+            self.penult, self.done, self.stats, self.rng, kv.k, kv.v,
+            draft_kv.k, draft_kv.v, width=width, steps=steps, greedy=greedy,
             gamma=gamma)
+        return toks, emit
+
+    def run_frame(self, runner, params, kv, width: int, steps: int,
+                  greedy: bool, draft=None):
+        """Execute one K-step frame: dispatch, then fetch the
+        (steps, B[, gamma+1]) token/emit pair — the only device→host
+        transfer a frame performs (``stats_delta`` adds one more tiny
+        frame-BOUNDARY read when telemetry is on)."""
+        toks, emit = self.dispatch_frame(runner, params, kv, width, steps,
+                                         greedy, draft=draft)
         return np.asarray(toks), np.asarray(emit)
+
+    def stats_delta(self) -> np.ndarray:
+        """Frame-boundary read of the in-graph counters: returns the
+        increment since the previous call and REBASES the device vector to
+        zero, so the int32 lanes would need 2^31 events between reads to
+        overflow. The caller owns the read cadence: the engine reads every
+        frame while telemetry is enabled, and after a disabled stretch it
+        discards the first (backlog, possibly wrapped) delta. Both the
+        read and the fresh zero vector are frame-boundary transfers."""
+        delta = np.asarray(self.stats).astype(np.int64)
+        self.stats = zero_stats()
+        return delta
 
     def absorb(self, toks: np.ndarray, emit: np.ndarray, width: int):
         """Replay the frame against the host mirrors (same arithmetic as the

@@ -1,0 +1,531 @@
+"""Serving telemetry: in-graph frame counters, request tracing, export.
+
+The frame loop (``engine_v2.serve``) exists to keep the host out of the
+decode path, which also removes every place a profiler hook or counter used
+to live. This module restores the telemetry surface WITHOUT reintroducing
+host round-trips, in three layers:
+
+1. **In-graph frame counters** — the serving scan bodies
+   (``model_runner._serving_scan_body`` / ``_spec_scan_body``) accumulate a
+   small ``(N_STATS,)`` int32 vector on the scan carry: tokens emitted,
+   active row-steps (the live-slot occupancy integral), prompt tokens
+   consumed, in-graph EOS events, and draft/verify counts under speculative
+   decoding. The vector rides the donated frame carry like every other slot
+   array, so it costs a handful of in-graph reductions and surfaces ONLY at
+   frame boundaries — zero extra device→host transfers inside a frame
+   (``tests/test_serving_telemetry.py`` pins this with a transfer guard).
+
+2. **Host request-lifecycle tracing** — ``serve()`` stamps
+   enqueue → admit → first-token → retire transitions per request into
+   fixed-memory log-bucketed histograms (``LogBucketHistogram``): TTFT,
+   inter-token latency, queue wait, and end-to-end latency, each with
+   p50/p90/p99 summaries. Inter-token latency is measured at frame
+   granularity: a row emitting ``n`` tokens in a frame records ``n`` samples
+   of ``gap / n`` where ``gap`` is the time since the row's previous
+   emission — intra-frame spacing is not host-observable by design.
+
+3. **Export** — ``render_prometheus()`` (text exposition format, scrapeable
+   behind any HTTP handler), frame-boundary event fan-out through a monitor
+   (anything with ``write_events([(tag, value, step)])`` — e.g.
+   ``monitor.MonitorMaster``), and an opt-in ``jax.profiler``
+   ``TraceAnnotation`` wrapper so device profiles line up with frames.
+
+``engine.serve_stats`` is a thin read-through view over this subsystem
+(``ServingTelemetry.serve_view``): the dict the pre-telemetry tests and
+``serving_bench.py`` already consume, now fed from the device counters.
+
+``enabled=False`` disables the HOST side only (no per-frame device counter
+sync, no histograms, no fan-out); the in-graph counters are always part of
+the compiled frame — they are a few scalar reductions, and keeping one
+program variant means toggling telemetry never recompiles anything.
+"""
+
+import math
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...utils.logging import logger
+
+# ---------------------------------------------------------------------------
+# in-graph stat vector layout (accumulated on the frame carry)
+# ---------------------------------------------------------------------------
+# Indices into the (N_STATS,) int32 vector the serving scan bodies carry.
+# Semantics per accumulation step:
+#   EMITTED        tokens emitted (sum of the emit mask)
+#   ACTIVE_STEPS   rows that did any work this step — the occupancy integral
+#   PREFILL_TOKS   prompt tokens consumed this step
+#   EOS            emitted tokens that hit their row's EOS id
+#   TARGET_FWD     decode-mode target forwards: plain decode row-steps, or
+#                  width-1 speculative VERIFY forwards (matching the
+#                  pre-telemetry serve_stats arithmetic exactly — decode
+#                  rows coasting inside wide speculative frames are not
+#                  verify forwards and are not counted here)
+#   DRAFTED        draft tokens proposed (gamma per verify forward)
+#   ACCEPTED       accepted-and-emitted draft tokens (emit columns >= 1)
+STAT_EMITTED = 0
+STAT_ACTIVE_STEPS = 1
+STAT_PREFILL_TOKS = 2
+STAT_EOS = 3
+STAT_TARGET_FWD = 4
+STAT_DRAFTED = 5
+STAT_ACCEPTED = 6
+N_STATS = 7
+
+STAT_NAMES = ("tokens_emitted", "active_row_steps", "prefill_tokens",
+              "eos_events", "target_forwards", "drafted_tokens",
+              "accepted_draft_tokens")
+
+
+def zero_stats():
+    """Fresh device stat vector for a frame carry."""
+    import jax.numpy as jnp
+    return jnp.zeros((N_STATS,), jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# fixed-memory log-bucketed histogram
+# ---------------------------------------------------------------------------
+
+
+class LogBucketHistogram:
+    """Log-bucketed latency histogram with O(1) memory and record cost.
+
+    ``n_buckets`` geometric buckets spanning ``[lo, lo * growth**n_buckets)``
+    plus one overflow bucket; values below ``lo`` land in bucket 0. With the
+    defaults (100 µs first bound, ×2 growth, 22 buckets) the span is
+    100 µs … ~7 min, which covers TTFT through E2E on one scale.
+
+    ``percentile(p)`` returns the geometric midpoint of the bucket holding
+    the p-quantile sample — the standard fixed-memory estimator; the error
+    is bounded by the bucket's growth factor. Deterministic given the same
+    recorded values, which is what the golden tests rely on.
+    """
+
+    def __init__(self, lo: float = 1e-4, growth: float = 2.0,
+                 n_buckets: int = 22):
+        assert lo > 0 and growth > 1 and n_buckets >= 1
+        self.lo = lo
+        self.growth = growth
+        self.n_buckets = n_buckets
+        self._log_g = math.log(growth)
+        # bucket i covers (bounds[i-1], bounds[i]]; bucket n_buckets = +Inf
+        self.bounds = [lo * growth ** i for i in range(n_buckets)]
+        self.counts = np.zeros(n_buckets + 1, np.int64)
+        self.total = 0
+        self.sum = 0.0
+
+    def record(self, value: float, count: int = 1) -> None:
+        if count <= 0:
+            return
+        if value <= self.lo:
+            idx = 0
+        else:
+            idx = min(int(math.ceil(math.log(value / self.lo) / self._log_g
+                                    - 1e-12)), self.n_buckets)
+        self.counts[idx] += count
+        self.total += count
+        self.sum += value * count
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100]; None when empty."""
+        if self.total == 0:
+            return None
+        rank = p / 100.0 * self.total
+        cum = 0
+        for i, c in enumerate(self.counts):
+            cum += int(c)
+            if cum >= rank and c > 0:
+                if i >= self.n_buckets:          # overflow bucket
+                    return self.bounds[-1] * self.growth
+                upper = self.bounds[i]
+                if i == 0:
+                    return upper / 2.0
+                return math.sqrt(upper / self.growth * upper)
+        return self.bounds[-1] * self.growth
+
+    def summary(self) -> Dict:
+        return {
+            "count": int(self.total),
+            "sum": round(self.sum, 6),
+            "p50": self.percentile(50), "p90": self.percentile(90),
+            "p99": self.percentile(99),
+        }
+
+    def reset(self) -> None:
+        self.counts[:] = 0
+        self.total = 0
+        self.sum = 0.0
+
+
+# ---------------------------------------------------------------------------
+# per-request lifecycle span
+# ---------------------------------------------------------------------------
+
+
+class _Span:
+    __slots__ = ("uid", "enqueue_t", "admit_t", "first_token_t",
+                 "last_emit_t", "tokens")
+
+    def __init__(self, uid: int, enqueue_t: float):
+        self.uid = uid
+        self.enqueue_t = enqueue_t
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.last_emit_t: Optional[float] = None
+        self.tokens = 0
+
+
+class ServingTelemetry:
+    """The serving telemetry subsystem (see module docstring).
+
+    ``clock`` is injectable (defaults to ``time.monotonic``) so lifecycle
+    tests can script deterministic timestamps. ``record_spans`` keeps the
+    last ``max_spans`` retired request records (bounded memory) for
+    per-request debugging; aggregation never needs them.
+    """
+
+    HIST_NAMES = ("ttft", "itl", "queue_wait", "e2e")
+
+    def __init__(self, enabled: bool = True, trace: bool = False,
+                 clock=time.monotonic, record_spans: bool = False,
+                 max_spans: int = 1024,
+                 defer_warn_interval_s: float = 5.0):
+        self.enabled = enabled
+        self.trace = trace
+        self.clock = clock
+        self.record_spans = record_spans
+        self.spans: deque = deque(maxlen=max_spans)
+        self.defer_warn_interval_s = defer_warn_interval_s
+        self.monitor = None
+        self.monitor_every = 1
+        # monitor step: monotonic across serve() runs (reset() zeroes the
+        # per-serve frame counter, but an attached TensorBoard/CSV writer
+        # must never see its step axis jump back to zero)
+        self.lifetime_frames = 0
+        self.reset()
+
+    # ------------------------------------------------------------------
+    # lifecycle of the subsystem itself
+    # ------------------------------------------------------------------
+
+    def reset(self) -> None:
+        """Zero every counter, histogram, and open span (new serve() run)."""
+        self._gamma = 0
+        self.counters: Dict[str, int] = {n: 0 for n in STAT_NAMES}
+        self.counters.update(requests_enqueued=0, requests_admitted=0,
+                             requests_retired=0, admission_deferrals=0,
+                             frames=0, slot_steps_capacity=0)
+        self.gauges: Dict[str, float] = {
+            "live_slots": 0, "slot_count": 0, "queue_depth": 0,
+            "kv_blocks_in_use": 0, "kv_blocks_in_use_peak": 0,
+            "kv_blocks_total": 0,
+            "occupancy": 0.0, "recompiled_programs": 0,
+        }
+        self.hists: Dict[str, LogBucketHistogram] = {
+            n: LogBucketHistogram() for n in self.HIST_NAMES}
+        self._open_spans: Dict[int, _Span] = {}
+        self._last_defer_warn: Optional[float] = None
+        self._defers_since_warn = 0
+        # serve_stats read-through view (engine.serve_stats returns this)
+        self.serve_view: Dict = {
+            "frames": 0, "frame_steps_last": None, "frame_steps_hist": {},
+            "arrival_ewma": 0.0, "adaptive_frame_steps": False,
+            "spec": {"gamma": 0, "target_forwards": 0, "emitted_tokens": 0,
+                     "accepted_drafts": 0, "acceptance_rate": None,
+                     "tokens_per_target_forward": None},
+            "telemetry_enabled": self.enabled,
+        }
+
+    def begin_serve(self, *, speculate: bool, gamma: int, adaptive: bool,
+                    n_slots: int, kv_blocks_total: int) -> None:
+        """Called by ``serve()`` at generator construction."""
+        self.reset()
+        self._gamma = gamma if speculate else 0
+        self.serve_view["adaptive_frame_steps"] = adaptive
+        self.serve_view["spec"]["gamma"] = self._gamma
+        self.gauges["slot_count"] = n_slots
+        self.gauges["kv_blocks_total"] = kv_blocks_total
+
+    def attach_monitor(self, monitor, every_frames: int = 1) -> None:
+        """Fan out frame-boundary events through ``monitor.write_events``
+        (e.g. a ``MonitorMaster`` → TensorBoard/CSV/W&B) every
+        ``every_frames`` frames. CSV writers open one file per tag per
+        flush — raise ``every_frames`` for high-frame-rate serving."""
+        self.monitor = monitor
+        self.monitor_every = max(1, every_frames)
+
+    # ------------------------------------------------------------------
+    # request lifecycle (host side, called from serve())
+    # ------------------------------------------------------------------
+
+    def on_enqueue(self, uid: int) -> None:
+        if not self.enabled:
+            return
+        self.counters["requests_enqueued"] += 1
+        self._open_spans[uid] = _Span(uid, self.clock())
+
+    def on_admit(self, uid: int) -> None:
+        if not self.enabled:
+            return
+        span = self._open_spans.get(uid)
+        if span is None:
+            return
+        span.admit_t = self.clock()
+        self.counters["requests_admitted"] += 1
+        self.hists["queue_wait"].record(span.admit_t - span.enqueue_t)
+
+    def on_emit(self, uid: int, n_tokens: int) -> None:
+        """``n_tokens`` emitted to ``uid`` at this frame boundary."""
+        if not self.enabled or n_tokens <= 0:
+            return
+        span = self._open_spans.get(uid)
+        if span is None:
+            return
+        now = self.clock()
+        if span.first_token_t is None:
+            span.first_token_t = now
+            self.hists["ttft"].record(now - span.enqueue_t)
+        else:
+            gap = max(0.0, now - span.last_emit_t)
+            self.hists["itl"].record(gap / n_tokens, count=n_tokens)
+        span.last_emit_t = now
+        span.tokens += n_tokens
+
+    def on_retire(self, uid: int) -> None:
+        if not self.enabled:
+            return
+        span = self._open_spans.pop(uid, None)
+        if span is None:
+            return
+        now = self.clock()
+        self.counters["requests_retired"] += 1
+        self.hists["e2e"].record(now - span.enqueue_t)
+        if self.record_spans:
+            self.spans.append({
+                "uid": span.uid, "enqueue_t": span.enqueue_t,
+                "admit_t": span.admit_t, "first_token_t": span.first_token_t,
+                "retire_t": now, "tokens": span.tokens,
+            })
+
+    def on_defer(self, queue_depth: int, frame_steps: Optional[int],
+                 free_slots: int, free_blocks: int) -> None:
+        """Admission deferred at least one arrival this frame boundary.
+
+        Overload used to be invisible; this logs a structured warning,
+        rate-limited to one per ``defer_warn_interval_s`` (with a count of
+        suppressed events), and counts every occurrence. Deliberately NOT
+        gated on ``enabled``: it fires at most once per overloaded frame
+        boundary, and losing the overload signal is the exact failure mode
+        this hook exists to fix — telemetry=False must not bring it back."""
+        self.counters["admission_deferrals"] += 1
+        self.gauges["queue_depth"] = queue_depth
+        now = self.clock()
+        self._defers_since_warn += 1
+        if (self._last_defer_warn is not None
+                and now - self._last_defer_warn < self.defer_warn_interval_s):
+            return
+        reason = "no free slots" if free_slots == 0 else \
+            f"KV pool pressure ({free_blocks} blocks free)"
+        logger.warning(
+            f"serve(): admission deferred ({reason}); queue_depth="
+            f"{queue_depth} frame_steps_bucket={frame_steps} "
+            f"free_slots={free_slots} free_kv_blocks={free_blocks} "
+            f"deferral_events_since_last_warning={self._defers_since_warn}")
+        self._last_defer_warn = now
+        self._defers_since_warn = 0
+
+    # ------------------------------------------------------------------
+    # frame boundary (device counter absorption + fan-out)
+    # ------------------------------------------------------------------
+
+    def on_frame(self, *, delta: np.ndarray, width: int, steps: int,
+                 live_slots: int, kv_blocks_in_use: int,
+                 arrival_ewma: float, recompiled_programs: int,
+                 queue_depth: int) -> None:
+        """Absorb one frame's device counter DELTA (``(N_STATS,)`` int64)
+        plus the host-known frame facts, update the serve_stats view, and
+        fan out to the attached monitor. When telemetry is disabled the
+        engine calls ``frame_view_update`` instead (so even the argument
+        gathering is skipped); the guard here is defensive for other
+        callers."""
+        if not self.enabled:
+            self.frame_view_update(width, steps, arrival_ewma)
+            return
+        for i, name in enumerate(STAT_NAMES):
+            self.counters[name] += int(delta[i])
+        self.counters["frames"] += 1
+        self.lifetime_frames += 1
+        # run-average occupancy = active_row_steps / slot_steps_capacity
+        # (the gauge below is the LAST frame's figure — drain frames sit
+        # near zero, so averages must come from the counters)
+        self.counters["slot_steps_capacity"] += \
+            int(self.gauges["slot_count"]) * steps
+        self.gauges["live_slots"] = live_slots
+        self.gauges["kv_blocks_in_use"] = kv_blocks_in_use
+        # instantaneous gauges go stale on the drain frames at the end of a
+        # run — the peak is the run-level KV-pressure figure
+        self.gauges["kv_blocks_in_use_peak"] = max(
+            self.gauges["kv_blocks_in_use_peak"], kv_blocks_in_use)
+        self.gauges["queue_depth"] = queue_depth
+        self.gauges["recompiled_programs"] = recompiled_programs
+        if self.gauges["slot_count"]:
+            self.gauges["occupancy"] = round(
+                int(delta[STAT_ACTIVE_STEPS])
+                / (self.gauges["slot_count"] * steps), 4)
+        self.frame_view_update(width, steps, arrival_ewma)
+        sp = self.serve_view["spec"]
+        if self._gamma:
+            sp["target_forwards"] = self.counters["target_forwards"]
+            # tokens emitted BY SPECULATIVE STEPS (the historical
+            # serve_stats semantics): every verify forward emits its column
+            # 0, plus the accepted drafts — prefill-completion tokens from
+            # wide frames are counted in tokens_emitted but not here
+            sp["emitted_tokens"] = (self.counters["target_forwards"]
+                                    + self.counters["accepted_draft_tokens"])
+            sp["accepted_drafts"] = self.counters["accepted_draft_tokens"]
+            if sp["target_forwards"]:
+                sp["acceptance_rate"] = round(
+                    sp["accepted_drafts"]
+                    / (self._gamma * sp["target_forwards"]), 4)
+                sp["tokens_per_target_forward"] = round(
+                    sp["emitted_tokens"] / sp["target_forwards"], 4)
+        if (self.monitor is not None
+                and self.counters["frames"] % self.monitor_every == 0):
+            self.monitor.write_events(self.monitor_events())
+
+    def frame_view_update(self, width: int, steps: int,
+                          arrival_ewma: float) -> None:
+        """The cheap host bookkeeping the pre-telemetry serve_stats always
+        had (frame count, frame-steps histogram, arrival EWMA) — the only
+        per-frame work that runs when telemetry is disabled."""
+        v = self.serve_view
+        v["telemetry_enabled"] = self.enabled   # stays live across toggles
+        v["frames"] += 1
+        v["frame_steps_last"] = steps
+        v["frame_steps_hist"][steps] = v["frame_steps_hist"].get(steps, 0) + 1
+        v["arrival_ewma"] = round(arrival_ewma, 4)
+
+    # ------------------------------------------------------------------
+    # export
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """Everything, as plain python (JSON-serializable)."""
+        out = {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "histograms": {n: h.summary() for n, h in self.hists.items()},
+            "spec": dict(self.serve_view["spec"]),
+        }
+        # tokens_per_target_forward lives ONLY in out["spec"] (computed from
+        # verify forwards + accepted drafts) — dividing total tokens_emitted
+        # by target_forwards would silently mix in prefill-completion
+        # emissions that no decode/verify forward produced
+        cap = self.counters["slot_steps_capacity"]
+        out["derived"] = {
+            "spec_acceptance_rate": self.serve_view["spec"]["acceptance_rate"],
+            "occupancy_avg": round(
+                self.counters["active_row_steps"] / cap, 4) if cap else None,
+        }
+        return out
+
+    def latency_ms(self) -> Dict[str, Dict]:
+        """p50/p90/p99 per histogram in milliseconds (None when empty) —
+        the shape serving_bench.py embeds in its JSON rows."""
+        out = {}
+        for n, h in self.hists.items():
+            s = h.summary()
+            out[n] = {
+                "count": s["count"],
+                **{p: (round(s[p] * 1e3, 3) if s[p] is not None else None)
+                   for p in ("p50", "p90", "p99")},
+            }
+        return out
+
+    def monitor_events(self) -> List:
+        """Frame-boundary event batch for ``Monitor.write_events``; the
+        step axis is ``lifetime_frames``, monotonic across serve() runs."""
+        step = self.lifetime_frames
+        ev = [(f"serving/{n}", float(v), step)
+              for n, v in self.counters.items()]
+        ev += [(f"serving/{n}", float(v), step)
+               for n, v in self.gauges.items()]
+        for n, h in self.hists.items():
+            for p in ("p50", "p90", "p99"):
+                q = h.percentile(float(p[1:]))
+                if q is not None:
+                    ev.append((f"serving/{n}_{p}_ms", q * 1e3, step))
+        return ev
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition snapshot (version 0.0.4).
+
+        Counters render as ``counter``, gauges as ``gauge``, and each
+        latency histogram as a full ``histogram`` (cumulative ``le``
+        buckets + ``_sum``/``_count``) with p50/p90/p99 beside it as a
+        ``summary``-style quantile series. Serve behind any HTTP handler::
+
+            from http.server import BaseHTTPRequestHandler, HTTPServer
+            class H(BaseHTTPRequestHandler):
+                def do_GET(self):
+                    body = engine.telemetry.render_prometheus().encode()
+                    self.send_response(200); self.end_headers()
+                    self.wfile.write(body)
+        """
+        lines: List[str] = []
+
+        def fmt(v: float) -> str:
+            f = float(v)
+            return str(int(f)) if f == int(f) else repr(f)
+
+        for name, val in self.counters.items():
+            full = f"ds_serving_{name}_total"
+            lines.append(f"# TYPE {full} counter")
+            lines.append(f"{full} {fmt(val)}")
+        for name, val in self.gauges.items():
+            full = f"ds_serving_{name}"
+            lines.append(f"# TYPE {full} gauge")
+            lines.append(f"{full} {fmt(val)}")
+        ar = self.serve_view["spec"]["acceptance_rate"]
+        lines.append("# TYPE ds_serving_spec_acceptance_rate gauge")
+        lines.append("ds_serving_spec_acceptance_rate "
+                     f"{fmt(ar) if ar is not None else 'NaN'}")
+        for name, h in self.hists.items():
+            full = f"ds_serving_{name}_seconds"
+            lines.append(f"# TYPE {full} histogram")
+            cum = 0
+            for bound, cnt in zip(h.bounds, h.counts[:-1]):
+                cum += int(cnt)
+                lines.append(f'{full}_bucket{{le="{bound:g}"}} {cum}')
+            lines.append(f'{full}_bucket{{le="+Inf"}} {h.total}')
+            lines.append(f"{full}_sum {h.sum:g}")
+            lines.append(f"{full}_count {h.total}")
+            for p in (50, 90, 99):
+                q = h.percentile(p)
+                if q is not None:
+                    lines.append(
+                        f'{full}_quantile{{quantile="0.{p}"}} {q:g}')
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------
+    # jax.profiler alignment
+    # ------------------------------------------------------------------
+
+    def frame_trace(self, width: int, steps: int):
+        """Context manager wrapping one frame in a named
+        ``jax.profiler.TraceAnnotation`` (opt-in via ``trace=True``), so a
+        captured device profile (``jax.profiler.trace(logdir)`` around a
+        serving run) shows frames as named spans that line up with the
+        request lifecycle timestamps recorded here."""
+        if not self.trace:
+            return nullcontext()
+        try:
+            import jax
+            return jax.profiler.TraceAnnotation(
+                f"serve_frame/w{width}/s{steps}")
+        except Exception:          # profiler unavailable: degrade silently
+            return nullcontext()

@@ -25,6 +25,15 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: anything wall-clock-sensitive (telemetry
+    # latency-value assertions, benchmarks) carries this marker so the
+    # deterministic CPU suite never flakes on timing
+    config.addinivalue_line(
+        "markers", "slow: wall-clock-sensitive or long-running; excluded "
+        "from the tier-1 CPU suite (-m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _reset_mesh():
     """Each test starts with a fresh (unset) global mesh."""

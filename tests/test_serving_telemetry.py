@@ -1,0 +1,552 @@
+"""Serving telemetry tests.
+
+The telemetry subsystem (``inference/v2/telemetry.py``) has one hard
+contract: every number it reports must match a host-side replay of the same
+arithmetic EXACTLY (the in-graph counters are not estimates), and measuring
+must add zero device→host transfers inside a frame. The scripted-schedule
+tests below derive ground truth from the SplitFuse scheduling arithmetic
+(prefill steps = ceil(P/chunk), decode steps = N-1 after the
+prefill-completing emission) and assert counter equality; the transfer-guard
+test pins the no-in-frame-transfer invariant; the histogram/Prometheus tests
+pin the fixed-memory bucket math and the exposition format.
+"""
+
+import logging
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.ragged_manager import DeviceSlotTable
+from deepspeed_tpu.inference.v2.telemetry import (LogBucketHistogram,
+                                                  ServingTelemetry)
+from deepspeed_tpu.models import build_model
+from deepspeed_tpu.utils.logging import logger as ds_logger
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh_8dp):
+    yield
+
+
+@pytest.fixture(scope="module")
+def tiny_model_params():
+    model = build_model("tiny")
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **over):
+    kw = dict(kv_block_size=16, prefill_chunk_size=16, max_tokens_per_step=256,
+              dtype="float32", max_ragged_batch_size=8, frame_steps=4)
+    kw.update(over)
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(**kw),
+                          max_seq_len=128)
+    e.params = jax.device_put(params)
+    return e
+
+
+PROMPT_LENS = {0: 7, 1: 24, 2: 33}
+MAX_NEW = 8
+CHUNK = 16
+
+
+def _prompts():
+    rng = np.random.default_rng(5)
+    return {u: rng.integers(0, 200, (n,)).astype(np.int32)
+            for u, n in PROMPT_LENS.items()}
+
+
+def _arrivals(prompts, schedule={0: [0, 1], 2: [2]}):
+    for k in range(max(schedule) + 2):
+        yield [(u, prompts[u]) for u in schedule.get(k, [])]
+
+
+class StubMonitor:
+    """Minimal Monitor-protocol sink: records every event batch."""
+
+    def __init__(self):
+        self.events = []
+
+    def write_events(self, events):
+        self.events.extend(events)
+
+
+@pytest.fixture(scope="module")
+def served(tiny_model_params, tmp_path_factory):
+    """ONE scripted serve() run, with a stub monitor AND a real
+    CSV-MonitorMaster attached; telemetry state is snapshotted immediately
+    (later tests reuse the engine, which resets the per-serve view)."""
+    from deepspeed_tpu.monitor.monitor import MonitorMaster
+    from deepspeed_tpu.runtime.config import DeepSpeedMonitorConfig
+
+    model, params = tiny_model_params
+    e = _engine(model, params)
+    stub = StubMonitor()
+    csv_dir = tmp_path_factory.mktemp("csv_monitor")
+    master = MonitorMaster(DeepSpeedMonitorConfig(
+        csv_monitor={"enabled": True, "output_path": str(csv_dir),
+                     "job_name": "serve"}))
+
+    class Tee:
+        def write_events(self, events):
+            stub.write_events(events)
+            master.write_events(events)
+
+    e.attach_monitor(Tee())
+    e.telemetry.record_spans = True
+    prompts = _prompts()
+    outs = dict(e.serve(_arrivals(prompts), max_new_tokens=MAX_NEW))
+    snap = {
+        "snapshot": e.telemetry.snapshot(),
+        "prom": e.telemetry.render_prometheus(),
+        "latency_ms": e.telemetry.latency_ms(),
+        "spans": list(e.telemetry.spans),
+        "events": list(stub.events),
+        "csv_dir": csv_dir,
+        "serve_view": {k: (dict(v) if isinstance(v, dict) else v)
+                       for k, v in e.serve_stats.items()},
+    }
+    return e, prompts, outs, snap
+
+
+# ---------------------------------------------------------------------------
+# in-graph counters vs host-replay ground truth
+# ---------------------------------------------------------------------------
+
+
+def test_counters_match_host_replay(served):
+    """The device counters must equal the SplitFuse arithmetic replayed on
+    the host: per row, ceil(P/chunk) prefill steps (the last one emits the
+    first token) then N-1 decode steps; no EOS in this schedule."""
+    _e, prompts, outs, snap = served
+    c = snap["snapshot"]["counters"]
+    n_tokens = sum(len(v) for v in outs.values())
+    assert n_tokens == len(PROMPT_LENS) * MAX_NEW
+    assert c["tokens_emitted"] == n_tokens
+    assert c["prefill_tokens"] == sum(PROMPT_LENS.values())
+    assert c["eos_events"] == 0
+    expect_decode_fwd = sum(MAX_NEW - 1 for _ in PROMPT_LENS)
+    assert c["target_forwards"] == expect_decode_fwd
+    expect_active = sum(-(-p // CHUNK) + MAX_NEW - 1
+                        for p in PROMPT_LENS.values())
+    assert c["active_row_steps"] == expect_active
+    assert c["drafted_tokens"] == 0 and c["accepted_draft_tokens"] == 0
+    assert c["requests_enqueued"] == c["requests_admitted"] \
+        == c["requests_retired"] == len(PROMPT_LENS)
+    assert c["admission_deferrals"] == 0
+    assert c["frames"] == snap["serve_view"]["frames"]
+
+
+def test_eos_counted_in_graph(tiny_model_params, served):
+    """A scripted per-row EOS registers exactly one in-graph EOS event and
+    one fewer emitted token than the budget."""
+    e, prompts, outs, _snap = served
+    eos = int(outs[0][2])
+    stop = outs[0].tolist().index(eos)
+    got = dict(e.serve(iter([[(0, prompts[0], None, None, eos)]]),
+                       max_new_tokens=MAX_NEW))
+    c = e.telemetry.counters
+    assert len(got[0]) == stop + 1
+    assert c["eos_events"] == 1
+    assert c["tokens_emitted"] == stop + 1
+
+
+def test_lifecycle_latency_histograms(served):
+    """TTFT/queue-wait/E2E get one sample per request; ITL gets one sample
+    per token after each row's first emission (frame-granularity measure)."""
+    _e, _prompts, outs, snap = served
+    lat = snap["latency_ms"]
+    n_req = len(PROMPT_LENS)
+    for name in ("ttft", "queue_wait", "e2e"):
+        assert lat[name]["count"] == n_req, (name, lat)
+        assert lat[name]["p50"] is not None and lat[name]["p50"] >= 0
+        assert lat[name]["p99"] is not None
+    assert 0 < lat["itl"]["count"] < n_req * MAX_NEW
+    spans = snap["spans"]
+    assert len(spans) == n_req
+    for s in spans:
+        assert s["enqueue_t"] <= s["admit_t"] <= s["first_token_t"] \
+            <= s["retire_t"]
+        assert s["tokens"] == MAX_NEW
+
+
+def test_occupancy_and_kv_gauges(served):
+    e, _prompts, _outs, snap = served
+    g = snap["snapshot"]["gauges"]
+    assert g["kv_blocks_total"] == e.kv.num_blocks
+    assert 1 <= g["kv_blocks_in_use"] <= e.kv.num_blocks
+    assert 0.0 < g["occupancy"] <= 1.0
+    assert g["slot_count"] == 8
+    assert g["recompiled_programs"] >= 1   # the frame programs themselves
+
+
+# ---------------------------------------------------------------------------
+# speculative counter parity (device counters vs host emit-mask replay)
+# ---------------------------------------------------------------------------
+
+
+def test_spec_counter_parity_with_host_replay(tiny_model_params, monkeypatch):
+    """serve_stats' speculative counters now come from the device; they must
+    equal the old host arithmetic (verify forwards = emit column 0 of
+    width-1 frames, accepted = the other columns) replayed on the frames'
+    emit masks — and the emitted totals must match the actual outputs."""
+    model, params = tiny_model_params
+    e = _engine(model, params)
+    e.attach_draft(model, params)           # self-draft: high acceptance
+
+    host = {"fwds": 0, "emitted": 0}
+    orig = DeviceSlotTable.run_frame
+
+    def spy(self, runner, eng_params, kv, width, steps, greedy, draft=None):
+        toks, emit = orig(self, runner, eng_params, kv, width, steps, greedy,
+                          draft=draft)
+        if emit.ndim == 3 and width == 1:
+            host["fwds"] += int(emit[:, :, 0].sum())
+            host["emitted"] += int(emit.sum())
+        return toks, emit
+
+    monkeypatch.setattr(DeviceSlotTable, "run_frame", spy)
+    prompts = _prompts()
+    outs = dict(e.serve(_arrivals(prompts), max_new_tokens=MAX_NEW, gamma=2))
+    sp = e.serve_stats["spec"]
+    assert sp["target_forwards"] == host["fwds"]
+    assert sp["emitted_tokens"] == host["emitted"]
+    assert sp["accepted_drafts"] == host["emitted"] - host["fwds"]
+    assert sp["acceptance_rate"] == round(
+        sp["accepted_drafts"] / (2 * sp["target_forwards"]), 4)
+    c = e.telemetry.counters
+    assert c["tokens_emitted"] == sum(len(v) for v in outs.values())
+    assert c["drafted_tokens"] == 2 * sp["target_forwards"]
+    # self-draft under greedy: near-full acceptance => >2 tokens per verify
+    assert sp["tokens_per_target_forward"] > 2.0, sp
+
+
+# ---------------------------------------------------------------------------
+# no in-frame host transfers
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_adds_no_in_frame_transfers(served, monkeypatch):
+    """Frame dispatch performs ZERO device→host transfers with telemetry on:
+    the counters ride the donated carry and are read only at the frame
+    boundary (outside the guarded region, with the token/emit fetch)."""
+    e, prompts, _outs, _snap = served
+
+    orig = DeviceSlotTable.dispatch_frame
+
+    def guarded(self, *a, **kw):
+        with jax.transfer_guard_device_to_host("disallow"):
+            return orig(self, *a, **kw)
+
+    monkeypatch.setattr(DeviceSlotTable, "dispatch_frame", guarded)
+    got = dict(e.serve(iter([[(0, prompts[0]), (1, prompts[1])]]),
+                       max_new_tokens=MAX_NEW))
+    assert len(got) == 2 and all(len(v) == MAX_NEW for v in got.values())
+    assert e.telemetry.counters["tokens_emitted"] == 2 * MAX_NEW
+
+
+# ---------------------------------------------------------------------------
+# overload deferral visibility
+# ---------------------------------------------------------------------------
+
+
+def test_admission_deferral_warns_once_and_counts(served):
+    """Overloading every slot logs ONE rate-limited structured warning
+    (queue depth + frame bucket included) while the deferral counter keeps
+    counting every deferred frame boundary."""
+    e, _prompts, _outs, _snap = served
+    rng = np.random.default_rng(21)
+    # 10 arrivals into 8 slots; 24-token prompts reuse the served fixture's
+    # compiled shape buckets (prompt width 32, table width 4)
+    arr = [(u, rng.integers(0, 200, (24,)).astype(np.int32))
+           for u in range(10)]
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = Capture()
+    ds_logger.addHandler(h)
+    try:
+        got = dict(e.serve(iter([arr]), max_new_tokens=MAX_NEW))
+    finally:
+        ds_logger.removeHandler(h)
+    assert len(got) == 10
+    warns = [m for m in records if "admission deferred" in m]
+    assert len(warns) == 1, warns          # rate-limited to one
+    assert "queue_depth=2" in warns[0]
+    assert "frame_steps_bucket=" in warns[0]
+    assert e.telemetry.counters["admission_deferrals"] >= 2
+
+
+def test_defer_warning_rate_limit_scripted_clock():
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    tel = ServingTelemetry(clock=clk, defer_warn_interval_s=5.0)
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = Capture()
+    ds_logger.addHandler(h)
+    try:
+        tel.on_defer(queue_depth=3, frame_steps=8, free_slots=0,
+                     free_blocks=11)
+        clk.t = 1.0
+        tel.on_defer(queue_depth=4, frame_steps=8, free_slots=0,
+                     free_blocks=11)
+        clk.t = 6.1                        # past the interval: warns again
+        tel.on_defer(queue_depth=5, frame_steps=4, free_slots=0,
+                     free_blocks=11)
+    finally:
+        ds_logger.removeHandler(h)
+    warns = [m for m in records if "admission deferred" in m]
+    assert len(warns) == 2
+    assert "queue_depth=3" in warns[0] and "no free slots" in warns[0]
+    assert "deferral_events_since_last_warning=2" in warns[1]
+    assert tel.counters["admission_deferrals"] == 3
+
+
+# ---------------------------------------------------------------------------
+# histogram bucket math (fixed memory, exact placement)
+# ---------------------------------------------------------------------------
+
+
+def test_log_bucket_histogram_math():
+    h = LogBucketHistogram(lo=1e-3, growth=10.0, n_buckets=3)
+    assert h.bounds == [1e-3, 1e-2, 1e-1]
+    for v in (0.0005, 0.001, 0.005, 0.01, 0.05, 5.0):
+        h.record(v)
+    # placement: <= lo -> bucket 0; bound-exact values stay in their bucket;
+    # past the top bound -> overflow
+    np.testing.assert_array_equal(h.counts, [2, 2, 1, 1])
+    assert h.total == 6
+    assert abs(h.sum - 5.0665) < 1e-12
+    # p50: rank 3 lands in bucket 1 -> geometric midpoint sqrt(1e-3 * 1e-2)
+    assert abs(h.percentile(50) - 10 ** -2.5) < 1e-12
+    # p10: rank 0.6 -> bucket 0 -> upper/2
+    assert h.percentile(10) == 0.0005
+    # p99: rank 5.94 -> overflow bucket -> top bound * growth
+    assert h.percentile(99) == 1.0
+    assert LogBucketHistogram().percentile(50) is None   # empty
+    h.reset()
+    assert h.total == 0 and h.sum == 0.0
+    # weighted record: one call, n samples
+    h.record(0.02, count=5)
+    assert h.counts[2] == 5 and h.total == 5
+
+
+def test_scripted_lifecycle_stamps():
+    """Deterministic clock: every histogram sample lands where the
+    enqueue→admit→first-token→retire arithmetic says it must."""
+    class Clock:
+        t = 0.0
+
+        def __call__(self):
+            return self.t
+
+    clk = Clock()
+    tel = ServingTelemetry(clock=clk, record_spans=True)
+    tel.begin_serve(speculate=False, gamma=0, adaptive=False, n_slots=4,
+                    kv_blocks_total=64)
+    clk.t = 10.0
+    tel.on_enqueue(7)
+    clk.t = 10.5
+    tel.on_admit(7)                         # queue_wait = 0.5
+    clk.t = 11.0
+    tel.on_emit(7, 3)                       # first emission: TTFT = 1.0
+    clk.t = 12.0
+    tel.on_emit(7, 2)                       # 2 ITL samples of 0.5
+    clk.t = 13.0
+    tel.on_retire(7)                        # e2e = 3.0
+    assert tel.hists["queue_wait"].total == 1
+    assert tel.hists["ttft"].total == 1
+    assert abs(tel.hists["ttft"].sum - 1.0) < 1e-9
+    assert tel.hists["itl"].total == 2
+    assert abs(tel.hists["itl"].sum - 1.0) < 1e-9    # 2 x 0.5
+    assert abs(tel.hists["e2e"].sum - 3.0) < 1e-9
+    assert tel.counters["requests_retired"] == 1
+    (span,) = tel.spans
+    assert span == {"uid": 7, "enqueue_t": 10.0, "admit_t": 10.5,
+                    "first_token_t": 11.0, "retire_t": 13.0, "tokens": 5}
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+
+def test_prometheus_render_golden():
+    """Exact text for one histogram section (cumulative le buckets, sum,
+    count, quantiles) — the scrape format is a wire contract."""
+    tel = ServingTelemetry(clock=lambda: 0.0)
+    h = LogBucketHistogram(lo=1e-3, growth=10.0, n_buckets=3)
+    for v in (0.0005, 0.005, 0.05, 5.0):
+        h.record(v)
+    tel.hists = {"ttft": h}
+    text = tel.render_prometheus()
+    golden = """# TYPE ds_serving_ttft_seconds histogram
+ds_serving_ttft_seconds_bucket{le="0.001"} 1
+ds_serving_ttft_seconds_bucket{le="0.01"} 2
+ds_serving_ttft_seconds_bucket{le="0.1"} 3
+ds_serving_ttft_seconds_bucket{le="+Inf"} 4
+ds_serving_ttft_seconds_sum 5.0555
+ds_serving_ttft_seconds_count 4
+ds_serving_ttft_seconds_quantile{quantile="0.50"} 0.00316228
+ds_serving_ttft_seconds_quantile{quantile="0.90"} 1
+ds_serving_ttft_seconds_quantile{quantile="0.99"} 1"""
+    assert golden in text
+    # counters and gauges render with their types
+    assert "# TYPE ds_serving_tokens_emitted_total counter" in text
+    assert "ds_serving_tokens_emitted_total 0" in text
+    assert "# TYPE ds_serving_kv_blocks_in_use gauge" in text
+    assert "ds_serving_spec_acceptance_rate NaN" in text
+    assert text.endswith("\n")
+
+
+def test_prometheus_render_from_serve(served):
+    """The acceptance-criteria surface: a scripted serve() run exposes
+    token counts, occupancy, KV usage, and latency quantiles via
+    render_prometheus()."""
+    _e, _prompts, outs, snap = served
+    text = snap["prom"]
+    n_tokens = sum(len(v) for v in outs.values())
+    assert f"ds_serving_tokens_emitted_total {n_tokens}" in text
+    assert f"ds_serving_requests_retired_total {len(outs)}" in text
+    assert 'ds_serving_ttft_seconds_bucket{le="+Inf"} 3' in text
+    assert "ds_serving_ttft_seconds_count 3" in text
+    assert 'ds_serving_e2e_seconds_quantile{quantile="0.99"}' in text
+    assert "ds_serving_occupancy" in text
+    assert "ds_serving_kv_blocks_in_use" in text
+
+
+# ---------------------------------------------------------------------------
+# MonitorMaster fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_fanout(served):
+    """Frame-boundary events reach both an arbitrary write_events sink and
+    a real CSV MonitorMaster (one file per tag, step = frame index)."""
+    _e, _prompts, outs, snap = served
+    events = snap["events"]
+    tags = {t for t, _v, _s in events}
+    assert "serving/tokens_emitted" in tags
+    assert "serving/kv_blocks_in_use" in tags
+    assert "serving/ttft_p50_ms" in tags
+    final = {t: v for t, v, _s in events}    # last write per tag
+    assert final["serving/tokens_emitted"] == sum(
+        len(v) for v in outs.values())
+    csv_files = list((snap["csv_dir"] / "serve").glob("*.csv"))
+    assert any(f.name == "serving_tokens_emitted.csv" for f in csv_files)
+
+
+# ---------------------------------------------------------------------------
+# compile-count satellites
+# ---------------------------------------------------------------------------
+
+
+def test_compile_count_total_monotonic_and_reset():
+    class FakeJit:
+        def __init__(self, n):
+            self.n = n
+
+        def _cache_size(self):
+            return self.n
+
+    from deepspeed_tpu.inference.v2.model_runner import PagedModelRunner
+    r = PagedModelRunner.__new__(PagedModelRunner)   # no model needed
+    r._fns = {"frame": FakeJit(3), "chunk16": FakeJit(2)}
+    r._evicted_programs = 0
+    r._compile_base = 0
+    assert r.compile_count() == {"frame": 3, "chunk16": 2}
+    assert r.compile_count_total() == 5
+    # eviction (draft re-attach) must not lower the monotonic total
+    r.evict("frame", "missing")
+    assert "frame" not in r._fns
+    assert r.compile_count_total() == 5
+    r._fns["spec_frame"] = FakeJit(4)
+    assert r.compile_count_total() == 9
+    r.reset_compile_count()
+    assert r.compile_count_total() == 0
+    r._fns["spec_frame"].n = 6
+    assert r.compile_count_total() == 2
+
+
+def test_recompile_gauge_exported(served):
+    _e, _prompts, _outs, snap = served
+    assert "ds_serving_recompiled_programs" in snap["prom"]
+    assert snap["snapshot"]["gauges"]["recompiled_programs"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# telemetry-off mode
+# ---------------------------------------------------------------------------
+
+
+def test_telemetry_disabled_keeps_serve_stats_shape(served):
+    """telemetry=False skips the host stats path but serve_stats keeps the
+    frame bookkeeping shape (and serving output is unchanged)."""
+    e, prompts, outs, _snap = served
+    e.telemetry.enabled = False
+    try:
+        got = dict(e.serve(iter([[(0, prompts[0])]]),
+                           max_new_tokens=MAX_NEW))
+    finally:
+        e.telemetry.enabled = True
+    np.testing.assert_array_equal(got[0], outs[0])
+    view = e.serve_stats
+    assert view["frames"] >= 1 and view["frame_steps_last"] == 4
+    assert e.telemetry.counters["tokens_emitted"] == 0   # host path idle
+    assert e.telemetry.hists["ttft"].total == 0
+
+
+def test_telemetry_reenabled_mid_serve_discards_backlog(served):
+    """Flipping telemetry on mid-serve must not dump the disabled-period
+    device-counter backlog into one frame: the transition frame is rebased
+    and discarded, so counters reflect only fully-measured frames and the
+    occupancy gauge stays a ratio."""
+    e, _prompts, _outs, _snap = served
+    rng = np.random.default_rng(23)
+    p0 = rng.integers(0, 200, (9,)).astype(np.int32)
+    p1 = rng.integers(0, 200, (14,)).astype(np.int32)
+    e.telemetry.enabled = False
+    try:
+        gen = e.serve(iter([[(0, p0, 4), (1, p1, 16)]]), max_new_tokens=16)
+        uid, toks = next(gen)          # uid 0 retires first (budget 4)
+        assert uid == 0 and len(toks) == 4
+        e.telemetry.enabled = True     # re-enable while uid 1 is mid-decode
+        rest = dict(gen)
+    finally:
+        e.telemetry.enabled = True
+    assert len(rest[1]) == 16
+    c = e.telemetry.counters
+    # only frames after the (discarded) transition frame are counted
+    assert 0 < c["tokens_emitted"] < 4 + 16
+    assert 0.0 < e.telemetry.gauges["occupancy"] <= 1.0
+    snap = e.telemetry.snapshot()
+    assert 0.0 < snap["derived"]["occupancy_avg"] <= 1.0
+    assert c["active_row_steps"] <= c["slot_steps_capacity"]
+
+
+@pytest.mark.slow
+def test_wall_clock_latency_values_plausible(tiny_model_params):
+    """Wall-clock-sensitive (hence slow-marked): real latencies must be
+    positive and ordered TTFT <= E2E for a single-request serve."""
+    model, params = tiny_model_params
+    e = _engine(model, params)
+    prompts = _prompts()
+    dict(e.serve(iter([[(0, prompts[0])]]), max_new_tokens=MAX_NEW))
+    lat = e.telemetry.latency_ms()
+    assert lat["ttft"]["p50"] > 0
+    assert lat["e2e"]["p50"] >= lat["ttft"]["p50"]
