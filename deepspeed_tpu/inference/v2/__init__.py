@@ -1,6 +1,9 @@
 """FastGen-analog ragged serving engine (paged KV, SplitFuse, frame loop).
 
-The telemetry surface is re-exported here so serving front-ends can build
-scrape endpoints without reaching into module internals."""
+The telemetry and scheduler surfaces are re-exported here so serving
+front-ends can build scrape endpoints and admission policies without
+reaching into module internals."""
 
+from .scheduler import (RequestScheduler, SchedulerConfig,  # noqa: F401
+                        ShedReason)
 from .telemetry import LogBucketHistogram, ServingTelemetry  # noqa: F401

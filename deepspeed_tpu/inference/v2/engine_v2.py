@@ -515,23 +515,49 @@ class InferenceEngineV2:
 
     @staticmethod
     def _norm_arrival(item, max_new_tokens, temperature, eos_token_id):
-        """(uid, tokens[, max_new_tokens[, temperature[, eos_id]]]) with
-        serve()-level defaults filled in; None in any optional field means
-        "use the default" (pass eos_id=-1 to disable EOS for one row when a
-        serve()-level eos_token_id is set)."""
-        uid, toks = item[0], item[1]
-        limit = item[2] if len(item) > 2 and item[2] is not None else max_new_tokens
-        temp = item[3] if len(item) > 3 and item[3] is not None else temperature
-        eos = item[4] if len(item) > 4 and item[4] is not None else eos_token_id
+        """Normalize one arrival to ``(uid, tokens, limit, temp, eos,
+        tenant, priority, slo_ms)``.
+
+        Tuple form: ``(uid, tokens[, max_new_tokens[, temperature[,
+        eos_id]]])`` with serve()-level defaults filled in; None in any
+        optional field means "use the default" (pass eos_id=-1 to disable
+        EOS for one row when a serve()-level eos_token_id is set). Tuples
+        carry no scheduling metadata (tenant/priority/slo_ms are None).
+
+        Dict form (the scheduler-aware surface): ``{"uid", "tokens"}`` plus
+        optional ``max_new_tokens``/``temperature``/``eos_token_id`` and the
+        scheduling fields ``tenant`` (str), ``priority`` ("interactive" |
+        "batch" | "best_effort" or 0..2), ``slo_ms`` (per-request TTFT
+        target that tightens the scheduler's pressure loop). The scheduling
+        fields are inert without a ``scheduler=``."""
+        if isinstance(item, dict):
+            uid, toks = item["uid"], item["tokens"]
+            limit = item.get("max_new_tokens")
+            limit = max_new_tokens if limit is None else limit
+            temp = item.get("temperature")
+            temp = temperature if temp is None else temp
+            eos = item.get("eos_token_id")
+            eos = eos_token_id if eos is None else eos
+            tenant, prio = item.get("tenant"), item.get("priority")
+            slo_ms = item.get("slo_ms")
+        else:
+            uid, toks = item[0], item[1]
+            limit = item[2] if len(item) > 2 and item[2] is not None \
+                else max_new_tokens
+            temp = item[3] if len(item) > 3 and item[3] is not None \
+                else temperature
+            eos = item[4] if len(item) > 4 and item[4] is not None \
+                else eos_token_id
+            tenant = prio = slo_ms = None
         return uid, np.asarray(toks, np.int32).reshape(-1), int(limit), \
-            float(temp), eos
+            float(temp), eos, tenant, prio, slo_ms
 
     def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
               temperature: float = 0.0, eos_token_id: Optional[int] = None,
               frame_steps: Optional[int] = None,
               frame_slots: Optional[int] = None,
               speculate: Optional[bool] = None, gamma: Optional[int] = None,
-              rng=None):
+              rng=None, scheduler=None):
         """Continuous batching with dynamic arrivals at compiled-loop speed.
 
         Generator: yields ``(uid, generated_tokens)`` as sequences finish.
@@ -573,6 +599,16 @@ class InferenceEngineV2:
         EWMA arrival-rate estimate; an explicit ``frame_steps=`` argument
         pins it.
 
+        ``scheduler`` (a ``scheduler.RequestScheduler``) replaces the FIFO
+        admission deque with the SLO-aware policy object: priority classes
+        with aging, per-tenant weighted fair-share and quotas, TTFT-SLO
+        load shedding/deferral, and frame-boundary preemption (see
+        ``scheduler.py`` and README "Scheduling & SLOs"). Arrivals may then
+        be dicts carrying ``tenant``/``priority``/``slo_ms``. All policy
+        runs host-side at frame boundaries — zero new in-frame transfers —
+        and with ``scheduler=None`` this method keeps the original FIFO
+        code path byte-for-byte.
+
         While a ``serve`` generator is live it owns the engine's scheduler
         state — don't interleave ``step()``/``generate()`` calls.
         """
@@ -605,6 +641,11 @@ class InferenceEngineV2:
         self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
                                    adaptive=adaptive, n_slots=n_slots,
                                    kv_blocks_total=self.kv.num_blocks)
+        if scheduler is not None:
+            scheduler.begin_serve(self)
+            return self._serve_guarded_sched(
+                slots, arrivals, scheduler, steps, max_new_tokens,
+                temperature, eos_token_id, speculate, gamma, adaptive)
         return self._serve_guarded(slots, arrivals, steps, max_new_tokens,
                                    temperature, eos_token_id, speculate,
                                    gamma, adaptive)
@@ -628,6 +669,23 @@ class InferenceEngineV2:
             for item in pending:
                 self.state.flush_sequence(item[0])
 
+    def _serve_guarded_sched(self, slots, arrivals, sched, steps,
+                             max_new_tokens, temperature, eos_token_id,
+                             speculate, gamma, adaptive):
+        try:
+            yield from self._serve_loop_sched(
+                slots, arrivals, sched, steps, max_new_tokens, temperature,
+                eos_token_id, speculate=speculate, gamma=gamma,
+                adaptive=adaptive)
+        finally:
+            # same abandonment contract as the FIFO path: slot-held AND
+            # scheduler-queued sequences (including preempted ones holding
+            # their emitted tokens) must release their descriptors/blocks
+            for uid in list(slots.slot_of_uid):
+                self.state.flush_sequence(uid)
+            for uid in sched.queued_uids():
+                self.state.flush_sequence(uid)
+
     @staticmethod
     def _pick_frame_steps(ewma: float, max_steps: int, saturated: bool) -> int:
         """Adaptive frame length (ROADMAP item (c)): the pow2 bucket whose
@@ -641,10 +699,73 @@ class InferenceEngineV2:
         if saturated or ewma < 0.125:
             return max_steps
         target = max(1.0, max_steps / (1.0 + ewma))
-        p = 1
-        while p * 2 <= target:
-            p *= 2
-        return min(p, max_steps)
+        return min(BlockedKVCache.floor_pow2(target), max_steps)
+
+    def _validate_arrival(self, uid, toks, limit, in_flight: bool) -> int:
+        """Shared serve() enqueue-time validation (FIFO and scheduler
+        paths); returns the (possibly clamped) generation budget."""
+        if uid < 0:
+            raise ValueError(
+                f"uid={uid}: serve() uids must be >= 0 (-1 is "
+                "the free-slot sentinel)")
+        if in_flight:
+            raise ValueError(
+                f"uid={uid} is already live in the slot table — "
+                "serve() uids must be unique among in-flight "
+                "requests")
+        if uid in self.state.seqs:
+            raise ValueError(
+                f"uid={uid} is already tracked by the engine "
+                "(stale from an earlier put()/generate()?) — "
+                "flush it before serving, or it would inherit "
+                "the old descriptor's tokens")
+        if len(toks) + 2 > self.max_seq_len:
+            raise ValueError(
+                f"uid={uid}: prompt of {len(toks)} tokens can "
+                f"never fit max_seq_len={self.max_seq_len}")
+        if len(toks) + limit + 1 > self.max_seq_len:
+            clamped = self.max_seq_len - len(toks) - 1
+            logger.warning(
+                f"uid={uid}: prompt ({len(toks)}) + budget "
+                f"({limit}) + 1 exceeds max_seq_len="
+                f"{self.max_seq_len}; clamping budget to "
+                f"{clamped}")
+            limit = clamped
+        return limit
+
+    def _sync_frame_stats(self, slots, width, cur_steps, ewma, queue_depth,
+                          stats_synced):
+        """Frame-boundary counter absorption, shared by both serve loops.
+
+        The in-graph counters replay the old host arithmetic exactly
+        (verify forwards = emit column 0; accepted drafts = the rest;
+        accepted-but-not-emitted drafts at budget/EOS truncation are
+        NOT counted, so acceptance_rate is the rate of draft slots
+        that became useful tokens). One tiny frame-BOUNDARY read.
+        The disabled path must stay the true zero-stats baseline, so
+        even the argument gathering (counter sync, compile totals,
+        mirror scans) is gated, not just the absorption."""
+        tel = self.telemetry
+        if tel.enabled and stats_synced:
+            tel.on_frame(
+                delta=slots.stats_delta(),
+                width=width, steps=cur_steps,
+                live_slots=slots.live_count(),
+                kv_blocks_in_use=self.kv.num_blocks - self.kv.free_blocks,
+                arrival_ewma=ewma,
+                recompiled_programs=self.runner.compile_count_total(),
+                queue_depth=queue_depth)
+            return True
+        if tel.enabled:
+            # telemetry re-enabled mid-serve: the device vector holds
+            # the whole disabled-period backlog (possibly int32-wrapped,
+            # and this frame's events are mixed into it) — rebase and
+            # discard; counters only count frames measured while enabled
+            slots.stats_delta()
+            tel.frame_view_update(width, cur_steps, ewma)
+            return True
+        tel.frame_view_update(width, cur_steps, ewma)
+        return False
 
     def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
                     temperature, eos_token_id, speculate=False, gamma=0,
@@ -670,42 +791,20 @@ class InferenceEngineV2:
                 # for this round, so a bad request can't strand blocks
                 # already reserved for earlier items in the same batch
                 for item in (batch or []):
-                    uid, toks, limit, temp, eos = self._norm_arrival(
-                        item, max_new_tokens, temperature, eos_token_id)
-                    if uid < 0:
-                        raise ValueError(
-                            f"uid={uid}: serve() uids must be >= 0 (-1 is "
-                            "the free-slot sentinel)")
-                    if uid in slots.slot_of_uid or \
-                            any(p[0] == uid for p in pending):
-                        raise ValueError(
-                            f"uid={uid} is already live in the slot table — "
-                            "serve() uids must be unique among in-flight "
-                            "requests")
-                    if uid in self.state.seqs:
-                        raise ValueError(
-                            f"uid={uid} is already tracked by the engine "
-                            "(stale from an earlier put()/generate()?) — "
-                            "flush it before serving, or it would inherit "
-                            "the old descriptor's tokens")
-                    if len(toks) + 2 > self.max_seq_len:
-                        raise ValueError(
-                            f"uid={uid}: prompt of {len(toks)} tokens can "
-                            f"never fit max_seq_len={self.max_seq_len}")
-                    if len(toks) + limit + 1 > self.max_seq_len:
-                        clamped = self.max_seq_len - len(toks) - 1
-                        logger.warning(
-                            f"uid={uid}: prompt ({len(toks)}) + budget "
-                            f"({limit}) + 1 exceeds max_seq_len="
-                            f"{self.max_seq_len}; clamping budget to "
-                            f"{clamped}")
-                        limit = clamped
+                    uid, toks, limit, temp, eos, _ten, _pri, _slo = \
+                        self._norm_arrival(item, max_new_tokens, temperature,
+                                           eos_token_id)
+                    limit = self._validate_arrival(
+                        uid, toks, limit,
+                        in_flight=uid in slots.slot_of_uid or
+                        any(p[0] == uid for p in pending))
                     pending.append((uid, toks, limit, temp, eos))
                     tel.on_enqueue(uid)
             # ---- admission control (FIFO; blocks reserved for the whole
             # prompt + generation budget up front, so block tables never
             # grow mid-flight) ----
             admits = []
+            blocks_before = self.kv.free_blocks
             while pending and len(admits) < slots.free_slots():
                 uid, toks, limit, temp, eos = pending[0]
                 seq = self.state.get_or_create_sequence(uid)
@@ -724,12 +823,17 @@ class InferenceEngineV2:
                 # overload is otherwise invisible: the deferred arrivals
                 # just wait in FIFO order — count it and warn (rate-limited).
                 # admit() hasn't executed yet, so subtract this round's
-                # admits or a full table would be misreported as KV pressure
+                # admits or a full table would be misreported as KV
+                # pressure; likewise free_blocks already reflects this
+                # round's reservations, so thread the reserved count through
+                # to keep standing pressure distinguishable from a busy
+                # admission round
                 tel.on_defer(
                     queue_depth=len(pending),
                     frame_steps=tel.serve_view["frame_steps_last"] or steps,
                     free_slots=slots.free_slots() - len(admits),
-                    free_blocks=self.kv.free_blocks)
+                    free_blocks=self.kv.free_blocks,
+                    reserved_blocks=blocks_before - self.kv.free_blocks)
             if admits:
                 slots.ensure_widths(
                     max(len(a[2]) for a in admits),
@@ -745,9 +849,10 @@ class InferenceEngineV2:
             # are the speculative draft/verify frames when a draft rides) ----
             width = c.prefill_chunk_size if slots.any_prefilling() else 1
             cur_steps = steps
+            saturated = slots.free_slots() == 0
             if adaptive:
-                cur_steps = self._pick_frame_steps(
-                    ewma, steps, slots.free_slots() == 0)
+                cur_steps = self._pick_frame_steps(ewma, steps, saturated)
+            tel.on_frame_plan(ewma, saturated, cur_steps)
             draft = None
             if speculate:
                 draft = (self.draft_runner, self.draft_params, self.draft_kv,
@@ -756,34 +861,8 @@ class InferenceEngineV2:
                 toks, emit = slots.run_frame(
                     self.runner, self.params, self.kv, width, cur_steps,
                     slots.all_greedy(), draft=draft)
-            # the in-graph counters replay the old host arithmetic exactly
-            # (verify forwards = emit column 0; accepted drafts = the rest;
-            # accepted-but-not-emitted drafts at budget/EOS truncation are
-            # NOT counted, so acceptance_rate is the rate of draft slots
-            # that became useful tokens). One tiny frame-BOUNDARY read.
-            # The disabled path must stay the true zero-stats baseline, so
-            # even the argument gathering (counter sync, compile totals,
-            # mirror scans) is gated, not just the absorption.
-            if tel.enabled and stats_synced:
-                tel.on_frame(
-                    delta=slots.stats_delta(),
-                    width=width, steps=cur_steps,
-                    live_slots=slots.live_count(),
-                    kv_blocks_in_use=self.kv.num_blocks - self.kv.free_blocks,
-                    arrival_ewma=ewma,
-                    recompiled_programs=self.runner.compile_count_total(),
-                    queue_depth=len(pending))
-            elif tel.enabled:
-                # telemetry re-enabled mid-serve: the device vector holds
-                # the whole disabled-period backlog (possibly int32-wrapped,
-                # and this frame's events are mixed into it) — rebase and
-                # discard; counters only count frames measured while enabled
-                slots.stats_delta()
-                tel.frame_view_update(width, cur_steps, ewma)
-                stats_synced = True
-            else:
-                tel.frame_view_update(width, cur_steps, ewma)
-                stats_synced = False
+            stats_synced = self._sync_frame_stats(
+                slots, width, cur_steps, ewma, len(pending), stats_synced)
             emissions, finished = slots.absorb(toks, emit, width)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
@@ -799,6 +878,173 @@ class InferenceEngineV2:
                 out = np.asarray(seq.generated, np.int64)
                 slots.retire(uid)
                 self.state.flush_sequence(uid)
+                tel.on_retire(uid)
+                yield uid, out
+
+    # ------------------------------------------------------------------
+    # SLO-aware scheduled serving (scheduler.RequestScheduler)
+    # ------------------------------------------------------------------
+
+    def _evict_to_queue(self, uid, slots, sched):
+        """Preempt a live row at a frame boundary: freeze its device slot,
+        release its KV blocks, fold its emitted tokens into the request's
+        prompt (re-admission re-prefills the committed prefix — token-
+        identical under greedy decoding), and re-queue it at the front of
+        its class/tenant queue."""
+        from .scheduler import PRIORITY_NAMES
+        seq = self.state.seqs[uid]
+        req = sched.on_evict(uid)
+        emitted = seq.generated[req.gen_base:]
+        if emitted:
+            req.tokens = np.concatenate(
+                [np.asarray(req.tokens, np.int32),
+                 np.asarray(emitted, np.int32)])
+            req.limit -= len(emitted)
+        slots.evict(uid)
+        if seq.blocks:
+            self.kv.allocator.free(seq.blocks)
+            seq.blocks = []
+        sched.requeue_front(req)
+        self.telemetry.on_preempt(uid, req.tenant,
+                                  PRIORITY_NAMES[req.priority])
+
+    def _serve_loop_sched(self, slots, arrivals, sched, steps,
+                          max_new_tokens, temperature, eos_token_id,
+                          speculate=False, gamma=0, adaptive=False):
+        """The scheduler-driven twin of ``_serve_loop``: same frame
+        execution and retirement contract, but enqueue/admission flow
+        through the ``RequestScheduler`` policy object, with an SLO
+        control pass, optional preemption, and pressure-capped frame
+        sizes at each boundary. All of it is host-side boundary work —
+        the frames themselves are untouched."""
+        from .scheduler import (PRIORITY_NAMES, Request, normalize_priority)
+        c = self._config
+        tel = self.telemetry
+        alpha = c.frame_steps_ewma_alpha
+        ewma = 0.0
+        exhausted = False
+        stats_synced = True
+        while True:
+            # ---- poll the arrival clock ----
+            if exhausted:
+                batch = None
+                ewma = (1.0 - alpha) * ewma
+            else:
+                try:
+                    batch = next(arrivals)
+                except StopIteration:
+                    exhausted = True
+                    batch = None
+                ewma = alpha * len(batch or []) + (1.0 - alpha) * ewma
+                for item in (batch or []):
+                    uid, toks, limit, temp, eos, tenant, prio, slo_ms = \
+                        self._norm_arrival(item, max_new_tokens, temperature,
+                                           eos_token_id)
+                    limit = self._validate_arrival(
+                        uid, toks, limit,
+                        in_flight=uid in slots.slot_of_uid or
+                        sched.is_queued(uid))
+                    prio = normalize_priority(prio)
+                    tenant = tenant or "default"
+                    tel.on_enqueue(uid, tenant=tenant,
+                                   pclass=PRIORITY_NAMES[prio])
+                    shed = sched.submit(Request(
+                        uid=uid, tokens=toks, limit=limit, temp=temp,
+                        eos=eos, tenant=tenant, priority=prio,
+                        slo_ms=slo_ms))
+                    if shed is not None:
+                        tel.on_shed(uid, shed.tenant, shed.priority,
+                                    shed.reason)
+            # ---- SLO control pass: age queues, refill fair-share credit,
+            # recompute pressure, shed best-effort work under critical
+            # pressure (structured reasons land in sched.shed_log) ----
+            for shed in sched.on_boundary(tel.slo_view(),
+                                          live_count=slots.live_count()):
+                tel.on_shed(shed.uid, shed.tenant, shed.priority,
+                            shed.reason)
+                # a shed request may have a blockless descriptor left by a
+                # failed capacity probe — drop it, or the uid could never
+                # be reused
+                self.state.flush_sequence(shed.uid)
+            tel.gauges["slo_risk"] = round(sched.risk, 4)
+            # ---- frame-boundary preemption: make room for a queued
+            # interactive arrival by evicting a lower-priority live row ----
+            if sched.preempt_wanted(slots.free_slots()):
+                committed = {u: int(slots.committed_h[s])
+                             for u, s in slots.slot_of_uid.items()}
+                for uid in sched.pick_victims(
+                        committed, free_blocks=self.kv.free_blocks):
+                    self._evict_to_queue(uid, slots, sched)
+            # ---- policy admission (strict priority + fair share) ----
+            blocks_before = self.kv.free_blocks
+
+            def try_reserve(req):
+                seq = self.state.get_or_create_sequence(req.uid)
+                if not self.state.ensure_capacity(
+                        seq, len(req.tokens) + req.limit + 1):
+                    return None
+                return seq
+
+            admits = []
+            for req, seq in sched.pick(slots.free_slots(), try_reserve,
+                                       live_count=slots.live_count()):
+                seq.done = False
+                req.gen_base = len(seq.generated)
+                admits.append((req.uid, seq, req.tokens, req.limit,
+                               req.temp, req.eos))
+                tel.on_admit(req.uid)
+            if sched.queued_count():
+                tel.on_defer(
+                    queue_depth=sched.queued_count(),
+                    frame_steps=tel.serve_view["frame_steps_last"] or steps,
+                    free_slots=slots.free_slots() - len(admits),
+                    free_blocks=self.kv.free_blocks,
+                    reserved_blocks=blocks_before - self.kv.free_blocks)
+            if admits:
+                slots.ensure_widths(
+                    max(len(a[2]) for a in admits),
+                    max(len(a[1].blocks) for a in admits),
+                    self.max_seq_len, self.max_blocks_per_seq)
+                slots.admit(admits)
+            if slots.live_count() == 0:
+                if exhausted and not sched.queued_count():
+                    return
+                continue
+            # ---- frame plan: the scheduler's pressure signal caps the
+            # frame length so admission boundaries come around sooner
+            # while interactive latency is at risk ----
+            width = c.prefill_chunk_size if slots.any_prefilling() else 1
+            cur_steps = steps
+            saturated = slots.free_slots() == 0
+            if adaptive:
+                cur_steps = self._pick_frame_steps(ewma, steps, saturated)
+            cur_steps = min(cur_steps, sched.frame_steps_cap(steps))
+            tel.on_frame_plan(ewma, saturated, cur_steps)
+            draft = None
+            if speculate:
+                draft = (self.draft_runner, self.draft_params, self.draft_kv,
+                         gamma)
+            with tel.frame_trace(width, cur_steps):
+                toks, emit = slots.run_frame(
+                    self.runner, self.params, self.kv, width, cur_steps,
+                    slots.all_greedy(), draft=draft)
+            stats_synced = self._sync_frame_stats(
+                slots, width, cur_steps, ewma, sched.queued_count(),
+                stats_synced)
+            emissions, finished = slots.absorb(toks, emit, width)
+            for uid, new_toks in emissions.items():
+                seq = self.state.seqs[uid]
+                seq.generated.extend(new_toks)
+                seq.seen_tokens = int(
+                    slots.committed_h[slots.slot_of_uid[uid]])
+                tel.on_emit(uid, len(new_toks))
+            for uid in finished:
+                seq = self.state.seqs[uid]
+                seq.done = True
+                out = np.asarray(seq.generated, np.int64)
+                slots.retire(uid)
+                self.state.flush_sequence(uid)
+                sched.on_retire(uid)
                 tel.on_retire(uid)
                 yield uid, out
 

@@ -53,6 +53,17 @@ class BlockedKVCache:
             w *= 2
         return min(w, cap)
 
+    @staticmethod
+    def floor_pow2(n: float) -> int:
+        """Largest power of two <= ``n`` (min 1) — the frame-steps bucket
+        floor shared by the adaptive frame sizer and the scheduler's
+        pressure cap, so both draw from the SAME pow2 bucket set and the
+        frame jit cache stays O(log) in the steps argument."""
+        p = 1
+        while p * 2 <= n:
+            p *= 2
+        return p
+
     @property
     def free_blocks(self) -> int:
         return self.allocator.free_blocks

@@ -247,6 +247,22 @@ class DeviceSlotTable:
         self.uid_of_slot[slot] = -1
         self.done_h[slot] = True
 
+    def evict(self, uid: int) -> None:
+        """Evict a LIVE row back to the host at a frame boundary (scheduler
+        preemption). Unlike ``retire``, the device row is NOT already
+        frozen, so this writes ``done=True, limits=0`` — the frozen-row
+        invariant — before freeing the slot: the next frame gives the row
+        width 0 and ``admit`` can rewrite it for a new request. One tiny
+        host→device write at the boundary; nothing is read back (the host
+        mirrors already hold the committed watermark and emitted tokens,
+        so the caller re-queues prompt + emitted for re-prefill)."""
+        slot = self.slot_of_uid.pop(uid)
+        self.uid_of_slot[slot] = -1
+        self.done_h[slot] = True
+        idx = jnp.asarray([slot], jnp.int32)
+        self.done = self.done.at[idx].set(True)
+        self.limits = self.limits.at[idx].set(0)
+
     # ---------------- frame execution + host replay ----------------
 
     def dispatch_frame(self, runner, params, kv, width: int, steps: int,

@@ -168,15 +168,18 @@ class LogBucketHistogram:
 
 class _Span:
     __slots__ = ("uid", "enqueue_t", "admit_t", "first_token_t",
-                 "last_emit_t", "tokens")
+                 "last_emit_t", "tokens", "tenant", "pclass")
 
-    def __init__(self, uid: int, enqueue_t: float):
+    def __init__(self, uid: int, enqueue_t: float,
+                 tenant: Optional[str] = None, pclass: Optional[str] = None):
         self.uid = uid
         self.enqueue_t = enqueue_t
         self.admit_t: Optional[float] = None
         self.first_token_t: Optional[float] = None
         self.last_emit_t: Optional[float] = None
         self.tokens = 0
+        self.tenant = tenant        # scheduler metadata (None without one)
+        self.pclass = pclass
 
 
 class ServingTelemetry:
@@ -193,13 +196,19 @@ class ServingTelemetry:
     def __init__(self, enabled: bool = True, trace: bool = False,
                  clock=time.monotonic, record_spans: bool = False,
                  max_spans: int = 1024,
-                 defer_warn_interval_s: float = 5.0):
+                 defer_warn_interval_s: float = 5.0,
+                 slo_window: int = 64, steps_trace_len: int = 128):
         self.enabled = enabled
         self.trace = trace
         self.clock = clock
         self.record_spans = record_spans
         self.spans: deque = deque(maxlen=max_spans)
         self.defer_warn_interval_s = defer_warn_interval_s
+        # sliding-window sample counts for the LIVE SLO signal (slo_view):
+        # the cumulative histograms never forget a good warm-up, so the
+        # admission control loop reads a recent-window p90 instead
+        self.slo_window = slo_window
+        self.steps_trace_len = steps_trace_len
         self.monitor = None
         self.monitor_every = 1
         # monitor step: monotonic across serve() runs (reset() zeroes the
@@ -218,22 +227,39 @@ class ServingTelemetry:
         self.counters: Dict[str, int] = {n: 0 for n in STAT_NAMES}
         self.counters.update(requests_enqueued=0, requests_admitted=0,
                              requests_retired=0, admission_deferrals=0,
+                             requests_shed=0, requests_preempted=0,
                              frames=0, slot_steps_capacity=0)
         self.gauges: Dict[str, float] = {
             "live_slots": 0, "slot_count": 0, "queue_depth": 0,
             "kv_blocks_in_use": 0, "kv_blocks_in_use_peak": 0,
             "kv_blocks_total": 0,
             "occupancy": 0.0, "recompiled_programs": 0,
+            "slo_risk": 0.0, "frame_steps_chosen": 0,
         }
         self.hists: Dict[str, LogBucketHistogram] = {
             n: LogBucketHistogram() for n in self.HIST_NAMES}
+        # scheduler label surfaces: {metric: {((label, value), ...): count}}
+        # — cardinality is classes x tenants, bounded by the tenant set
+        self.labeled: Dict[str, Dict[tuple, int]] = {}
+        # per-class TTFT (the bench/SLO acceptance surface)
+        self.class_ttft: Dict[str, LogBucketHistogram] = {}
+        # live SLO signal windows (recent samples, seconds)
+        self._win: Dict[str, deque] = {
+            "ttft": deque(maxlen=self.slo_window),
+            "queue_wait": deque(maxlen=self.slo_window)}
+        # adaptive-frame-steps decision trace (ROADMAP follow-up (d)): a
+        # bounded ring of {frame, ewma, saturated, steps} records so
+        # frame-size oscillation is debuggable from serve_stats or a scrape
+        self.steps_trace: deque = deque(maxlen=self.steps_trace_len)
         self._open_spans: Dict[int, _Span] = {}
         self._last_defer_warn: Optional[float] = None
         self._defers_since_warn = 0
         # serve_stats read-through view (engine.serve_stats returns this)
         self.serve_view: Dict = {
             "frames": 0, "frame_steps_last": None, "frame_steps_hist": {},
+            "frame_steps_trace": self.steps_trace,
             "arrival_ewma": 0.0, "adaptive_frame_steps": False,
+            "slo": {"ttft_p90_ms": None, "queue_wait_p90_ms": None},
             "spec": {"gamma": 0, "target_forwards": 0, "emitted_tokens": 0,
                      "accepted_drafts": 0, "acceptance_rate": None,
                      "tokens_per_target_forward": None},
@@ -262,11 +288,25 @@ class ServingTelemetry:
     # request lifecycle (host side, called from serve())
     # ------------------------------------------------------------------
 
-    def on_enqueue(self, uid: int) -> None:
+    def _labels(self, span: Optional[_Span]) -> Optional[tuple]:
+        if span is None or (span.tenant is None and span.pclass is None):
+            return None
+        return (("class", span.pclass or "unknown"),
+                ("tenant", span.tenant or "unknown"))
+
+    def _inc_labeled(self, name: str, labels: Optional[tuple],
+                     n: int = 1) -> None:
+        if labels is None:
+            return
+        series = self.labeled.setdefault(name, {})
+        series[labels] = series.get(labels, 0) + n
+
+    def on_enqueue(self, uid: int, tenant: Optional[str] = None,
+                   pclass: Optional[str] = None) -> None:
         if not self.enabled:
             return
         self.counters["requests_enqueued"] += 1
-        self._open_spans[uid] = _Span(uid, self.clock())
+        self._open_spans[uid] = _Span(uid, self.clock(), tenant, pclass)
 
     def on_admit(self, uid: int) -> None:
         if not self.enabled:
@@ -274,9 +314,18 @@ class ServingTelemetry:
         span = self._open_spans.get(uid)
         if span is None:
             return
+        if span.admit_t is not None:
+            # RE-admission after a preemption: the request was already
+            # counted, and (now - enqueue_t) would log the row's live
+            # generation time as queue wait — poisoning the windowed SLO
+            # signal the scheduler sheds on. A request admits once.
+            return
         span.admit_t = self.clock()
         self.counters["requests_admitted"] += 1
-        self.hists["queue_wait"].record(span.admit_t - span.enqueue_t)
+        wait = span.admit_t - span.enqueue_t
+        self.hists["queue_wait"].record(wait)
+        self._win["queue_wait"].append(wait)
+        self._inc_labeled("requests_admitted", self._labels(span))
 
     def on_emit(self, uid: int, n_tokens: int) -> None:
         """``n_tokens`` emitted to ``uid`` at this frame boundary."""
@@ -288,12 +337,18 @@ class ServingTelemetry:
         now = self.clock()
         if span.first_token_t is None:
             span.first_token_t = now
-            self.hists["ttft"].record(now - span.enqueue_t)
+            ttft = now - span.enqueue_t
+            self.hists["ttft"].record(ttft)
+            self._win["ttft"].append(ttft)
+            if span.pclass is not None:
+                self.class_ttft.setdefault(
+                    span.pclass, LogBucketHistogram()).record(ttft)
         else:
             gap = max(0.0, now - span.last_emit_t)
             self.hists["itl"].record(gap / n_tokens, count=n_tokens)
         span.last_emit_t = now
         span.tokens += n_tokens
+        self._inc_labeled("tokens_emitted", self._labels(span), n_tokens)
 
     def on_retire(self, uid: int) -> None:
         if not self.enabled:
@@ -304,15 +359,66 @@ class ServingTelemetry:
         now = self.clock()
         self.counters["requests_retired"] += 1
         self.hists["e2e"].record(now - span.enqueue_t)
+        self._inc_labeled("requests_retired", self._labels(span))
         if self.record_spans:
-            self.spans.append({
+            rec = {
                 "uid": span.uid, "enqueue_t": span.enqueue_t,
                 "admit_t": span.admit_t, "first_token_t": span.first_token_t,
                 "retire_t": now, "tokens": span.tokens,
-            })
+            }
+            if span.tenant is not None or span.pclass is not None:
+                rec["tenant"] = span.tenant     # scheduler runs only — the
+                rec["pclass"] = span.pclass     # FIFO span shape is a golden
+            self.spans.append(rec)
+
+    def on_shed(self, uid: int, tenant: Optional[str] = None,
+                pclass: Optional[str] = None,
+                reason: Optional[str] = None) -> None:
+        """The scheduler rejected ``uid`` (SLO pressure or tenant quota).
+
+        Like ``on_defer``, deliberately NOT gated on ``enabled``: shedding
+        is a client-visible overload action — losing its count is the
+        failure mode telemetry exists to prevent."""
+        self.counters["requests_shed"] += 1
+        span = self._open_spans.pop(uid, None)
+        if span is not None:
+            self._inc_labeled("requests_shed", self._labels(span))
+        elif tenant is not None or pclass is not None:
+            self._inc_labeled("requests_shed",
+                              (("class", pclass or "unknown"),
+                               ("tenant", tenant or "unknown")))
+
+    def on_preempt(self, uid: int, tenant: Optional[str] = None,
+                   pclass: Optional[str] = None) -> None:
+        """A live row was evicted back to the queue at a frame boundary to
+        make room for an interactive arrival (span stays open — the
+        request is still in flight and will re-admit)."""
+        self.counters["requests_preempted"] += 1
+        span = self._open_spans.get(uid)
+        if span is not None:
+            self._inc_labeled("requests_preempted", self._labels(span))
+        elif tenant is not None or pclass is not None:
+            self._inc_labeled("requests_preempted",
+                              (("class", pclass or "unknown"),
+                               ("tenant", tenant or "unknown")))
+
+    def slo_view(self) -> Dict[str, Optional[float]]:
+        """LIVE SLO signal: p90 (ms) over the recent sample windows — the
+        input the scheduler's control loop reads each frame boundary (the
+        cumulative histograms would let a good warm-up mask a bad now).
+        Mirrored into ``serve_view['slo']`` for observability."""
+        out: Dict[str, Optional[float]] = {}
+        for name in ("ttft", "queue_wait"):
+            w = self._win[name]
+            out[f"{name}_p90_ms"] = round(
+                float(np.percentile(np.asarray(w), 90)) * 1e3, 3) if w \
+                else None
+        self.serve_view["slo"] = out
+        return out
 
     def on_defer(self, queue_depth: int, frame_steps: Optional[int],
-                 free_slots: int, free_blocks: int) -> None:
+                 free_slots: int, free_blocks: int,
+                 reserved_blocks: int = 0) -> None:
         """Admission deferred at least one arrival this frame boundary.
 
         Overload used to be invisible; this logs a structured warning,
@@ -320,7 +426,13 @@ class ServingTelemetry:
         suppressed events), and counts every occurrence. Deliberately NOT
         gated on ``enabled``: it fires at most once per overloaded frame
         boundary, and losing the overload signal is the exact failure mode
-        this hook exists to fix — telemetry=False must not bring it back."""
+        this hook exists to fix — telemetry=False must not bring it back.
+
+        ``free_blocks`` is the pool AFTER this round's admissions reserved
+        their blocks; ``reserved_blocks`` is that round's reservation, so
+        the warning can distinguish a pool that was already exhausted from
+        one this very boundary just consumed (without it, a busy admission
+        round reads as standing KV pressure)."""
         self.counters["admission_deferrals"] += 1
         self.gauges["queue_depth"] = queue_depth
         now = self.clock()
@@ -334,9 +446,23 @@ class ServingTelemetry:
             f"serve(): admission deferred ({reason}); queue_depth="
             f"{queue_depth} frame_steps_bucket={frame_steps} "
             f"free_slots={free_slots} free_kv_blocks={free_blocks} "
+            f"kv_blocks_reserved_this_round={reserved_blocks} "
             f"deferral_events_since_last_warning={self._defers_since_warn}")
         self._last_defer_warn = now
         self._defers_since_warn = 0
+
+    def on_frame_plan(self, ewma: float, saturated: bool,
+                      chosen: int) -> None:
+        """Record one frame-size decision (EWMA input, saturated flag,
+        chosen pow2 bucket) into the bounded ring surfaced as
+        ``serve_stats['frame_steps_trace']`` and the
+        ``ds_serving_frame_steps_chosen`` gauge. Always on (one dict append
+        per frame): frame-size oscillation is exactly the thing that needs
+        debugging when telemetry is otherwise being kept cheap."""
+        self.steps_trace.append({
+            "frame": self.serve_view["frames"], "ewma": round(ewma, 4),
+            "saturated": bool(saturated), "steps": int(chosen)})
+        self.gauges["frame_steps_chosen"] = int(chosen)
 
     # ------------------------------------------------------------------
     # frame boundary (device counter absorption + fan-out)
@@ -420,6 +546,16 @@ class ServingTelemetry:
             "gauges": dict(self.gauges),
             "histograms": {n: h.summary() for n, h in self.hists.items()},
             "spec": dict(self.serve_view["spec"]),
+            "labeled": {
+                name: {",".join(f"{k}={v}" for k, v in key): val
+                       for key, val in series.items()}
+                for name, series in self.labeled.items()},
+            "class_ttft_p90_ms": {
+                cls: (round(h.percentile(90) * 1e3, 3)
+                      if h.percentile(90) is not None else None)
+                for cls, h in self.class_ttft.items()},
+            "slo": dict(self.serve_view["slo"]),
+            "frame_steps_trace": list(self.steps_trace),
         }
         # tokens_per_target_forward lives ONLY in out["spec"] (computed from
         # verify forwards + accepted drafts) — dividing total tokens_emitted
@@ -486,10 +622,22 @@ class ServingTelemetry:
             full = f"ds_serving_{name}_total"
             lines.append(f"# TYPE {full} counter")
             lines.append(f"{full} {fmt(val)}")
+            # per-class/per-tenant scheduler labels share the family: one
+            # TYPE line, unlabeled total first, labeled samples after
+            for key, lval in sorted(self.labeled.get(name, {}).items()):
+                labels = ",".join(f'{k}="{v}"' for k, v in key)
+                lines.append(f"{full}{{{labels}}} {fmt(lval)}")
         for name, val in self.gauges.items():
             full = f"ds_serving_{name}"
             lines.append(f"# TYPE {full} gauge")
             lines.append(f"{full} {fmt(val)}")
+        if self.class_ttft:
+            full = "ds_serving_class_ttft_p90_seconds"
+            lines.append(f"# TYPE {full} gauge")
+            for cls in sorted(self.class_ttft):
+                q = self.class_ttft[cls].percentile(90)
+                if q is not None:
+                    lines.append(f'{full}{{class="{cls}"}} {q:g}')
         ar = self.serve_view["spec"]["acceptance_rate"]
         lines.append("# TYPE ds_serving_spec_acceptance_rate gauge")
         lines.append("ds_serving_spec_acceptance_rate "
@@ -510,6 +658,48 @@ class ServingTelemetry:
                     lines.append(
                         f'{full}_quantile{{quantile="0.{p}"}} {q:g}')
         return "\n".join(lines) + "\n"
+
+    def serve_metrics_http(self, port: int = 0, host: str = "127.0.0.1"):
+        """Serve ``render_prometheus()`` at ``/metrics`` from a stdlib
+        ``http.server`` daemon thread — the zero-dependency scrape endpoint
+        (ROADMAP telemetry follow-up (c))::
+
+            srv = engine.telemetry.serve_metrics_http(9100)
+            print(srv.metrics_port)      # bound port (pass 0 for ephemeral)
+            ...
+            srv.shutdown(); srv.server_close()
+
+        Returns the ``ThreadingHTTPServer``; each GET renders a fresh
+        snapshot, so a Prometheus scrape always sees the latest frame
+        boundary. Anything but ``/metrics`` (or ``/``) answers 404."""
+        import http.server
+        import threading
+
+        tel = self
+
+        class _MetricsHandler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.split("?")[0].rstrip("/") in ("", "/metrics"):
+                    body = tel.render_prometheus().encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, fmt, *args):   # scrapes are not log spam
+                pass
+
+        srv = http.server.ThreadingHTTPServer((host, port), _MetricsHandler)
+        srv.daemon_threads = True
+        srv.metrics_port = srv.server_address[1]
+        thread = threading.Thread(target=srv.serve_forever,
+                                  name="ds-serving-metrics", daemon=True)
+        thread.start()
+        return srv
 
     # ------------------------------------------------------------------
     # jax.profiler alignment
