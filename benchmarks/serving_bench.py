@@ -653,6 +653,134 @@ def bench_scheduler(model_name, batch, prompt_len, new_tokens,
     }
 
 
+def bench_chaos(model_name, batch, prompt_len, new_tokens, n_arrivals=12):
+    """Fault-tolerant serving under a FIXED fault schedule vs the
+    fault-free baseline, on one deterministic arrival schedule (one
+    arrival per frame-boundary poll — no wall clock, so both runs see
+    identical admission timing).
+
+    Three measured legs:
+
+    * **baseline** — fault-free serve (goodput reference);
+    * **chaos** — same schedule under transient dispatch failures
+      (absorbed by bounded retry), one poisoned row (quarantined
+      mid-flight), and a KV-alloc failure window (admission deferral);
+      overhead = the goodput cost of surviving all of it;
+    * **kill+resume** — same schedule again, crashed by a fatal dispatch
+      fault mid-run, then resumed from the automatic ledger snapshot;
+      reports the recovery-time gauge and end-to-end goodput including
+      the crash.
+
+    Correctness is asserted inline (surviving outputs token-identical to
+    the baseline, KV pool drained) — the chaos row doubles as a smoke
+    check, mirroring the telemetry-overhead row's tested-contract style."""
+    from deepspeed_tpu.inference.v2.faults import (FaultInjector,
+                                                   FrameDispatchError)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 1000, (prompt_len,)).astype(np.int32)
+               for _ in range(n_arrivals)]
+
+    def arrivals():
+        for u, p in enumerate(prompts):
+            yield [(u, p)]
+
+    def mk():
+        eng = _mk_engine(model_name, batch,
+                         expected_context=prompt_len + new_tokens)
+        eng._config.frame_retry_backoff_s = 0.0   # measure work, not sleep
+        return eng
+
+    def run(eng, faults=None, resume_from=None, arr=None):
+        outs, produced = {}, 0
+        t0 = time.perf_counter()
+        for uid, toks in eng.serve(arr if arr is not None else arrivals(),
+                                   max_new_tokens=new_tokens, faults=faults,
+                                   resume_from=resume_from):
+            outs[uid] = toks
+            produced += len(toks)
+        return outs, produced, time.perf_counter() - t0
+
+    eng = mk()
+    run(eng)                                         # compile
+    base_outs, base_produced, base_dt = run(eng)
+
+    poison_uid = n_arrivals // 2
+    chaos_schedule = [
+        {"kind": "dispatch_exception", "frame": 2, "times": 2},
+        {"kind": "poison_row", "frame": n_arrivals // 2, "uid": poison_uid},
+        {"kind": "kv_alloc_fail", "frame": 4, "times": 2},
+    ]
+    inj = FaultInjector(chaos_schedule)
+    chaos_outs, chaos_produced, chaos_dt = run(eng, faults=inj)
+    assert poison_uid not in chaos_outs, "poisoned row must not be yielded"
+    for u, toks in chaos_outs.items():
+        np.testing.assert_array_equal(base_outs[u], toks,
+                                      err_msg=f"uid={u} diverged under chaos")
+    assert eng.kv.free_blocks == eng.kv.num_blocks - 1
+    chaos_counters = {k: eng.telemetry.counters[k]
+                      for k in ("faults", "quarantined", "frame_retries",
+                                "deadline_expired")}
+
+    # ---- kill + resume: fatal fault mid-run, resume from the snapshot ----
+    fatal = FaultInjector([{"kind": "dispatch_exception",
+                            "frame": n_arrivals // 2, "times": 100}])
+    resumed_outs, produced_crash = {}, 0
+    t0 = time.perf_counter()
+    try:
+        for uid, toks in eng.serve(arrivals(), max_new_tokens=new_tokens,
+                                   faults=fatal):
+            resumed_outs[uid] = toks
+            produced_crash += len(toks)
+        raise AssertionError("fatal fault schedule did not crash the serve")
+    except FrameDispatchError:
+        pass
+    snap = eng.last_crash_snapshot
+    in_flight = len(snap["requests"])
+    rest, produced_rest, _ = run(eng, resume_from=snap, arr=iter([[]]))
+    resume_dt = time.perf_counter() - t0
+    resumed_outs.update(rest)
+    for u, toks in resumed_outs.items():
+        np.testing.assert_array_equal(
+            base_outs[u], toks, err_msg=f"uid={u} diverged across restart")
+    # arrivals the crashed run never polled are the front-end's to replay;
+    # completeness here covers everything the engine had accepted
+    recovery_ms = eng.telemetry.gauges["last_recovery_ms"]
+
+    base_tps = base_produced / base_dt
+    chaos_tps = chaos_produced / chaos_dt
+    resume_tps = (produced_crash + produced_rest) / resume_dt
+    return {
+        "workload": "chaos-serving", "batch": batch,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "arrivals": n_arrivals,
+        "fault_schedule": chaos_schedule,
+        "baseline_tok_per_sec": round(base_tps, 1),
+        "chaos_tok_per_sec": round(chaos_tps, 1),
+        # per-token time under chaos vs baseline (goodput-normalized, so
+        # the quarantined row's missing tokens don't read as overhead)
+        "chaos_overhead_pct": round(
+            100 * ((chaos_dt / chaos_produced)
+                   / (base_dt / base_produced) - 1), 2)
+        if chaos_produced else None,
+        "chaos_goodput_ratio": round(chaos_tps / base_tps, 4),
+        "chaos_counters": chaos_counters,
+        "kill_resume": {
+            "in_flight_at_crash": in_flight,
+            "recovery_ms": recovery_ms,
+            "goodput_tok_per_sec": round(resume_tps, 1),
+            "goodput_ratio_vs_baseline": round(resume_tps / base_tps, 4),
+            "recoveries": eng.telemetry.counters["recoveries"],
+        },
+        "note": "same deterministic schedule all three legs; chaos leg "
+                "survives 2 transient dispatch failures + 1 poisoned row "
+                "+ a 2-boundary KV-alloc outage (survivor outputs asserted "
+                "token-identical, pool drain asserted); kill+resume leg "
+                "crashes mid-run and resumes from the automatic ledger "
+                "snapshot (outputs asserted token-identical across the "
+                "restart)",
+    }
+
+
 def bench_mixed_compiled(model_name, batch, prompt_lens, new_tokens):
     """Mixed SplitFuse via the COMPILED loop (generate_compiled): staggered
     prompt lengths make early finishers decode inside wide prefill steps —
@@ -822,6 +950,13 @@ def main():
                     help="run only the scheduler-slo row (FIFO vs SLO-aware "
                          "admission under a deterministic 2-tenant overload "
                          "schedule: per-class TTFT p90, shed rate, goodput)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run only the chaos-serving row (fault-free "
+                         "baseline vs a fixed fault schedule — transient "
+                         "dispatch failures, a poisoned row, a KV-alloc "
+                         "outage — plus a kill-and-resume leg reporting "
+                         "recovery time and goodput; survivor outputs are "
+                         "asserted token-identical)")
     args = ap.parse_args()
     _logs_to_stderr()
     platform = jax.default_backend()
@@ -863,6 +998,27 @@ def main():
         except Exception as e:
             add({"workload": tag, "status": "failed",
                  "error_type": type(e).__name__, "error": str(e)[:300]})
+
+    if args.chaos:
+        # focused mode: fault tolerance vs the fault-free baseline only
+        b, p, n, arr = mixed_dynamic
+        guarded("chaos-serving", bench_chaos, model, b, p, n,
+                n_arrivals=max(arr, 12))
+        row = next((r for r in rows if r.get("workload") == "chaos-serving"),
+                   {})
+        print(json.dumps({
+            "metric": "fastgen_serving_chaos",
+            "model": model, "platform": platform,
+            "value": row.get("chaos_goodput_ratio"),
+            "unit": "chaos/baseline goodput ratio (fixed fault schedule)",
+            "rows": rows,
+        }))
+        # the chaos row's inline token-identity/leak asserts are a hard
+        # contract, exactly like the telemetry budget
+        if any(r.get("workload") == "chaos-serving"
+               and r.get("error_type") == "AssertionError" for r in rows):
+            sys.exit(1)
+        return
 
     if args.scheduler:
         # focused mode: the FIFO-vs-SLO-aware overload row only

@@ -1,9 +1,11 @@
 """FastGen-analog ragged serving engine (paged KV, SplitFuse, frame loop).
 
-The telemetry and scheduler surfaces are re-exported here so serving
-front-ends can build scrape endpoints and admission policies without
-reaching into module internals."""
+The telemetry, scheduler, and fault-tolerance surfaces are re-exported
+here so serving front-ends can build scrape endpoints, admission policies,
+and chaos/recovery harnesses without reaching into module internals."""
 
+from .faults import (FaultInjector, FaultReason,  # noqa: F401
+                     FaultSpec, FrameDispatchError, InjectedFault)
 from .scheduler import (RequestScheduler, SchedulerConfig,  # noqa: F401
                         ShedReason)
 from .telemetry import LogBucketHistogram, ServingTelemetry  # noqa: F401

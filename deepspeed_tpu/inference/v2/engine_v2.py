@@ -17,6 +17,7 @@ frame boundaries).
 
 import collections
 import dataclasses
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 import jax
@@ -27,6 +28,8 @@ from ...models.transformer import CausalLM
 from ...utils.logging import log_dist, logger
 from ..config import DeepSpeedInferenceConfig
 from ..sampling import sample_logits
+from .faults import (FaultReason, FrameDispatchError, LedgerEntry,
+                     snapshot_ledger)
 from .kv_cache import BlockedKVCache
 from .model_runner import PagedModelRunner
 from .ragged_manager import DeviceSlotTable, DSStateManager
@@ -81,6 +84,23 @@ class RaggedInferenceEngineConfig:
     # profiles line up with the request spans (opt-in: annotations cost a
     # little host time per frame even with no profiler attached)
     telemetry_trace: bool = False
+    # fault tolerance (faults.py / README "Fault tolerance & chaos
+    # testing"): a frame dispatch that raises is retried up to
+    # max_frame_retries times with exponential backoff (backoff * 2^attempt
+    # seconds) — injected faults and pre-dispatch host errors retry
+    # token-identically because the donated carry was never consumed; an
+    # error from inside the compiled frame invalidates the donated buffers,
+    # so the retry fails fast into the crash path (ledger snapshot +
+    # FrameDispatchError) instead of silently corrupting state
+    max_frame_retries: int = 2
+    frame_retry_backoff_s: float = 0.02
+    # wall-clock watchdog: warn + count (ds_serving_slow_frames_total) when
+    # one frame exceeds this many milliseconds. None disables. The watchdog
+    # never kills a frame — a jit cannot be safely interrupted — it makes
+    # stuck-behind-a-slow-frame time visible so per-request deadlines (the
+    # actual recovery mechanism) can act at the next boundary.
+    watchdog_frame_ms: Optional[float] = None
+    fault_log_max: int = 256
     dtype: str = "bfloat16"
 
 
@@ -126,6 +146,16 @@ class InferenceEngineV2:
         self.draft_kv = None
         self.telemetry = ServingTelemetry(enabled=c.telemetry,
                                           trace=c.telemetry_trace)
+        # fault tolerance (faults.py): structured abnormal-retirement log,
+        # the host-side request ledger serve() maintains for crash
+        # recovery, and the snapshot taken automatically when a frame
+        # dispatch fails fatally (serve(resume_from=...) consumes it)
+        self.fault_log: collections.deque = collections.deque(
+            maxlen=c.fault_log_max)
+        self.last_crash_snapshot: Optional[Dict] = None
+        self._ledger: Dict[int, LedgerEntry] = {}
+        self._resume_pending: set = set()
+        self._clock = time.monotonic
         if draft_model is not None:
             self.attach_draft(draft_model, draft_params)
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{bs} "
@@ -516,20 +546,25 @@ class InferenceEngineV2:
     @staticmethod
     def _norm_arrival(item, max_new_tokens, temperature, eos_token_id):
         """Normalize one arrival to ``(uid, tokens, limit, temp, eos,
-        tenant, priority, slo_ms)``.
+        tenant, priority, slo_ms, deadline_ms)``.
 
         Tuple form: ``(uid, tokens[, max_new_tokens[, temperature[,
         eos_id]]])`` with serve()-level defaults filled in; None in any
         optional field means "use the default" (pass eos_id=-1 to disable
         EOS for one row when a serve()-level eos_token_id is set). Tuples
-        carry no scheduling metadata (tenant/priority/slo_ms are None).
+        carry no scheduling metadata (tenant/priority/slo_ms/deadline_ms
+        are None).
 
         Dict form (the scheduler-aware surface): ``{"uid", "tokens"}`` plus
         optional ``max_new_tokens``/``temperature``/``eos_token_id`` and the
         scheduling fields ``tenant`` (str), ``priority`` ("interactive" |
         "batch" | "best_effort" or 0..2), ``slo_ms`` (per-request TTFT
-        target that tightens the scheduler's pressure loop). The scheduling
-        fields are inert without a ``scheduler=``."""
+        target that tightens the scheduler's pressure loop), ``deadline_ms``
+        (wall-clock budget from ENQUEUE: past it, the request is cancelled
+        at the next frame boundary — queued or live — its KV blocks freed
+        and a ``deadline_expired`` FaultReason recorded; works on BOTH the
+        FIFO and scheduler paths). tenant/priority/slo_ms are inert
+        without a ``scheduler=``."""
         if isinstance(item, dict):
             uid, toks = item["uid"], item["tokens"]
             limit = item.get("max_new_tokens")
@@ -540,6 +575,9 @@ class InferenceEngineV2:
             eos = eos_token_id if eos is None else eos
             tenant, prio = item.get("tenant"), item.get("priority")
             slo_ms = item.get("slo_ms")
+            deadline_ms = item.get("deadline_ms")
+            if deadline_ms is not None and deadline_ms <= 0:
+                raise ValueError(f"uid={uid}: deadline_ms must be > 0")
         else:
             uid, toks = item[0], item[1]
             limit = item[2] if len(item) > 2 and item[2] is not None \
@@ -548,16 +586,16 @@ class InferenceEngineV2:
                 else temperature
             eos = item[4] if len(item) > 4 and item[4] is not None \
                 else eos_token_id
-            tenant = prio = slo_ms = None
+            tenant = prio = slo_ms = deadline_ms = None
         return uid, np.asarray(toks, np.int32).reshape(-1), int(limit), \
-            float(temp), eos, tenant, prio, slo_ms
+            float(temp), eos, tenant, prio, slo_ms, deadline_ms
 
     def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
               temperature: float = 0.0, eos_token_id: Optional[int] = None,
               frame_steps: Optional[int] = None,
               frame_slots: Optional[int] = None,
               speculate: Optional[bool] = None, gamma: Optional[int] = None,
-              rng=None, scheduler=None):
+              rng=None, scheduler=None, faults=None, resume_from=None):
         """Continuous batching with dynamic arrivals at compiled-loop speed.
 
         Generator: yields ``(uid, generated_tokens)`` as sequences finish.
@@ -609,6 +647,23 @@ class InferenceEngineV2:
         and with ``scheduler=None`` this method keeps the original FIFO
         code path byte-for-byte.
 
+        Fault tolerance (``faults.py``, README "Fault tolerance & chaos
+        testing"): frame dispatch runs under bounded retry with exponential
+        backoff; a row whose logits go non-finite is quarantined at the
+        frame boundary (evicted, retired with a ``poison_row``
+        ``FaultReason`` in ``engine.fault_log``) while its batch siblings
+        keep decoding; arrivals may carry ``deadline_ms`` (enforced at
+        frame boundaries for queued AND live rows, freeing KV blocks on
+        expiry); and the host-side request ledger makes the loop
+        crash-recoverable: ``engine.snapshot_serving_state()`` (or the
+        automatic ``engine.last_crash_snapshot`` on a fatal dispatch
+        failure) feeds ``serve(..., resume_from=snapshot)``, which
+        re-admits every in-flight request by re-prefilling prompt +
+        committed tokens — greedy outputs are token-identical across the
+        restart. ``faults=`` takes a ``faults.FaultInjector`` whose
+        scripted schedule exercises these paths deterministically (chaos
+        tests, ``serving_bench.py --chaos``).
+
         While a ``serve`` generator is live it owns the engine's scheduler
         state — don't interleave ``step()``/``generate()`` calls.
         """
@@ -638,6 +693,11 @@ class InferenceEngineV2:
         slots = DeviceSlotTable(
             n_slots, prompt_width=c.prefill_chunk_size,
             table_width=1, rng=frame_rng)
+        if faults is not None:
+            faults.begin_serve()     # rearm the scripted schedule
+        resume = self._resume_entries(resume_from)
+        self._ledger = {}
+        self._resume_pending = {r[0] for r in resume}
         self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
                                    adaptive=adaptive, n_slots=n_slots,
                                    kv_blocks_total=self.kv.num_blocks)
@@ -645,46 +705,63 @@ class InferenceEngineV2:
             scheduler.begin_serve(self)
             return self._serve_guarded_sched(
                 slots, arrivals, scheduler, steps, max_new_tokens,
-                temperature, eos_token_id, speculate, gamma, adaptive)
+                temperature, eos_token_id, speculate, gamma, adaptive,
+                faults, resume)
         return self._serve_guarded(slots, arrivals, steps, max_new_tokens,
                                    temperature, eos_token_id, speculate,
-                                   gamma, adaptive)
+                                   gamma, adaptive, faults, resume)
 
     def _serve_guarded(self, slots, arrivals, steps, max_new_tokens,
-                       temperature, eos_token_id, speculate, gamma, adaptive):
+                       temperature, eos_token_id, speculate, gamma, adaptive,
+                       faults, resume):
         pending = collections.deque()
         try:
             yield from self._serve_loop(slots, arrivals, pending, steps,
                                         max_new_tokens, temperature,
                                         eos_token_id, speculate=speculate,
-                                        gamma=gamma, adaptive=adaptive)
+                                        gamma=gamma, adaptive=adaptive,
+                                        faults=faults, resume=resume)
         finally:
             # generator abandonment (break / close() / mid-stream error)
             # must not strand in-flight state: release every slot-held
             # sequence and every deferred arrival that already has a
             # descriptor, or their KV blocks leak and a later call reusing
-            # a uid would inherit stale generated tokens.
+            # a uid would inherit stale generated tokens. The ledger is
+            # the authoritative accepted-not-retired set — it also covers
+            # rows caught mid-transit by a fault between eviction and
+            # re-admission, which neither the slot table nor the pending
+            # deque sees.
             for uid in list(slots.slot_of_uid):
                 self.state.flush_sequence(uid)
             for item in pending:
                 self.state.flush_sequence(item[0])
+            for uid in list(self._ledger):
+                self.state.flush_sequence(uid)
+            self._ledger.clear()
 
     def _serve_guarded_sched(self, slots, arrivals, sched, steps,
                              max_new_tokens, temperature, eos_token_id,
-                             speculate, gamma, adaptive):
+                             speculate, gamma, adaptive, faults, resume):
         try:
             yield from self._serve_loop_sched(
                 slots, arrivals, sched, steps, max_new_tokens, temperature,
                 eos_token_id, speculate=speculate, gamma=gamma,
-                adaptive=adaptive)
+                adaptive=adaptive, faults=faults, resume=resume)
         finally:
             # same abandonment contract as the FIFO path: slot-held AND
             # scheduler-queued sequences (including preempted ones holding
-            # their emitted tokens) must release their descriptors/blocks
+            # their emitted tokens) must release their descriptors/blocks;
+            # the ledger sweep additionally covers a preempted row dropped
+            # between eviction and re-admission (evicted from the slot
+            # table but not yet back in a scheduler queue), whose folded
+            # tokens and descriptor would otherwise leak
             for uid in list(slots.slot_of_uid):
                 self.state.flush_sequence(uid)
             for uid in sched.queued_uids():
                 self.state.flush_sequence(uid)
+            for uid in list(self._ledger):
+                self.state.flush_sequence(uid)
+            self._ledger.clear()
 
     @staticmethod
     def _pick_frame_steps(ewma: float, max_steps: int, saturated: bool) -> int:
@@ -767,16 +844,247 @@ class InferenceEngineV2:
         tel.frame_view_update(width, cur_steps, ewma)
         return False
 
+    # ------------------------------------------------------------------
+    # fault tolerance: ledger, deadlines, quarantine, resilient dispatch
+    # (faults.py; README "Fault tolerance & chaos testing")
+    # ------------------------------------------------------------------
+
+    def snapshot_serving_state(self) -> Dict:
+        """Serialize the host-side request ledger of the current (or last)
+        serve run — every accepted, not-yet-retired request's original
+        prompt, committed tokens, remaining budget/deadline, and scheduling
+        metadata — as a plain-python dict. Zero device reads (the ledger
+        and the ``generated`` mirrors are host state the frame boundaries
+        already maintain). Feed it to ``serve(..., resume_from=)`` on a
+        restarted engine: resumed requests re-prefill prompt + committed
+        tokens via the preemption machinery, so greedy outputs are
+        token-identical across the restart (tokens from a frame that never
+        returned are simply re-generated). Sampled (temperature > 0) rows
+        resume correctly but not bit-identically — the frame RNG restarts.
+        """
+        return snapshot_ledger(self._ledger, self.state.seqs, self._clock)
+
+    def _ledger_add(self, uid, toks, limit, temp, eos, deadline_ms,
+                    tenant=None, priority=None, slo_ms=None,
+                    resumed_from=0) -> None:
+        self._ledger[uid] = LedgerEntry(
+            uid=uid, prompt=[int(t) for t in toks], limit=int(limit),
+            temp=float(temp), eos=eos,
+            deadline_at=(None if deadline_ms is None
+                         else self._clock() + deadline_ms * 1e-3),
+            tenant=tenant, priority=priority, slo_ms=slo_ms,
+            resumed_from=resumed_from)
+
+    def _resume_entries(self, resume_from) -> List[Tuple]:
+        """Normalize a ``snapshot_serving_state()`` dict into resume
+        ingestion tuples (validated eagerly, at the serve() call site)."""
+        if resume_from is None:
+            return []
+        if resume_from.get("version") != 1:
+            raise ValueError("resume_from: unrecognized snapshot "
+                             f"version {resume_from.get('version')!r}")
+        out = []
+        for r in resume_from.get("requests", []):
+            uid = int(r["uid"])
+            if uid in self.state.seqs:
+                raise ValueError(
+                    f"resume_from: uid={uid} is already tracked by the "
+                    "engine — flush it before resuming")
+            generated = [int(t) for t in r.get("generated", [])]
+            out.append((uid, np.asarray(r["prompt"], np.int32),
+                        int(r["limit"]), float(r["temp"]), r["eos"],
+                        r.get("deadline_remaining_ms"), generated,
+                        r.get("tenant"), r.get("priority"), r.get("slo_ms")))
+        return out
+
+    def _fault_retire(self, uid: int, kind: str, frame: int, detail: str,
+                      partial=None, tenant=None, priority=None) -> None:
+        """Abnormal request retirement: drop the ledger entry, record a
+        structured ``FaultReason`` (with the committed partial output), and
+        count it — the request is NOT yielded and NOT counted as a normal
+        retirement."""
+        ent = self._ledger.pop(uid, None)
+        if ent is not None:
+            tenant = tenant or ent.tenant
+            priority = priority if priority is not None else ent.priority
+        self.fault_log.append(FaultReason(
+            uid=uid, kind=kind, frame=frame, detail=detail,
+            tokens_emitted=len(partial or ()),
+            partial=list(partial) if partial else None,
+            tenant=tenant,
+            priority=str(priority) if priority is not None else None))
+        self.telemetry.on_fault(kind, uid=uid)
+        logger.warning(f"serve(): uid={uid} retired with fault "
+                       f"kind={kind} at frame {frame}: {detail}")
+
+    def _fault_event(self, kind: str, frame: int, detail: str) -> None:
+        """Frame-level fault event (no single victim request): retries,
+        slow frames, injected allocation failures, fatal crashes."""
+        self.fault_log.append(FaultReason(uid=-1, kind=kind, frame=frame,
+                                          detail=detail))
+        self.telemetry.on_fault(kind)
+        logger.warning(f"serve(): {kind} at frame {frame}: {detail}")
+
+    def _expire_deadlines(self, slots, frame: int, pending=None,
+                          sched=None) -> None:
+        """Frame-boundary deadline enforcement for queued AND live rows:
+        an expired request is cancelled wherever it sits — popped from the
+        FIFO deque / scheduler queue (BEFORE it can be admitted, aged, or
+        preempted for), or evicted from its live slot — its KV blocks are
+        freed and a ``deadline_expired`` timeout retirement is recorded."""
+        now = self._clock()
+        expired = [uid for uid, ent in self._ledger.items()
+                   if ent.deadline_at is not None and now >= ent.deadline_at]
+        for uid in expired:
+            seq = self.state.seqs.get(uid)
+            partial = list(seq.generated) if seq is not None else []
+            if uid in slots.slot_of_uid:
+                slots.evict(uid)
+                if sched is not None:
+                    sched.on_retire(uid)
+                where = f"live row ({len(partial)} tokens committed)"
+            else:
+                if sched is not None:
+                    sched.cancel(uid)
+                elif pending is not None:
+                    for item in pending:
+                        if item[0] == uid:
+                            pending.remove(item)
+                            break
+                where = "queued (never admitted)"
+            self.state.flush_sequence(uid)       # frees any KV blocks
+            self._fault_retire(uid, "deadline_expired", frame,
+                               detail=f"deadline_ms elapsed while {where}",
+                               partial=partial)
+
+    def _quarantine_nonfinite(self, slots, frame: int, sched=None) -> None:
+        """Poison-row quarantine: rows whose in-graph finite-check latch
+        tripped during the last frame are evicted (the preemption path:
+        freeze + free slot + free KV blocks) and retired with a
+        ``poison_row`` FaultReason — the batch never dies for one request.
+        One tiny boundary read (``nonfinite_uids``), nothing in-frame."""
+        for uid in slots.nonfinite_uids():
+            seq = self.state.seqs.get(uid)
+            partial = list(seq.generated) if seq is not None else []
+            slots.evict(uid)
+            if sched is not None:
+                sched.on_retire(uid)
+            self.state.flush_sequence(uid)
+            self._fault_retire(
+                uid, "poison_row", frame,
+                detail="non-finite logits (in-graph finite-check); row "
+                       "quarantined, siblings unaffected",
+                partial=partial)
+
+    def _run_frame_resilient(self, slots, width, cur_steps, greedy, draft,
+                             faults, frame: int):
+        """Dispatch one frame under the resilience policy: injected-fault
+        hooks, bounded retry with exponential backoff for transient
+        dispatch failures (the donated carry is untouched by a
+        pre-dispatch failure, so a retried frame is token-identical), a
+        wall-clock watchdog, and — when the retry budget is exhausted — an
+        automatic ledger snapshot (``last_crash_snapshot``) before the
+        crash surfaces as ``FrameDispatchError``."""
+        c = self._config
+        attempt = 0
+        while True:
+            try:
+                # the watchdog window opens before the injection hook: an
+                # injected stall simulates a slow DISPATCH, so it must be
+                # inside the measured span
+                t0 = self._clock()
+                if faults is not None:
+                    faults.before_dispatch(frame, attempt)
+                toks, emit = slots.run_frame(self.runner, self.params,
+                                             self.kv, width, cur_steps,
+                                             greedy, draft=draft)
+                dt_ms = (self._clock() - t0) * 1e3
+                if c.watchdog_frame_ms is not None \
+                        and dt_ms > c.watchdog_frame_ms:
+                    self._fault_event(
+                        "slow_frame", frame,
+                        f"frame took {dt_ms:.1f} ms > watchdog "
+                        f"{c.watchdog_frame_ms} ms (width={width} "
+                        f"steps={cur_steps})")
+                return toks, emit
+            except Exception as e:        # noqa: BLE001 — bounded + re-raised
+                attempt += 1
+                if attempt > c.max_frame_retries:
+                    self.last_crash_snapshot = self.snapshot_serving_state()
+                    self._fault_event(
+                        "dispatch_failed", frame,
+                        f"{type(e).__name__}: {e} (after {attempt} attempts)")
+                    raise FrameDispatchError(
+                        f"frame {frame} dispatch failed after {attempt} "
+                        f"attempts ({type(e).__name__}: {e}); "
+                        "engine.last_crash_snapshot holds the request "
+                        "ledger — serve(resume_from=...) resumes the "
+                        "in-flight requests") from e
+                self._fault_event(
+                    "dispatch_retry", frame,
+                    f"{type(e).__name__}: {e} (attempt {attempt}/"
+                    f"{c.max_frame_retries}, retrying)")
+                backoff = c.frame_retry_backoff_s * (2 ** (attempt - 1))
+                if backoff > 0:
+                    time.sleep(backoff)
+
+    def _note_recovery_progress(self, slots, resume_t0: float,
+                                n_resumed: int) -> None:
+        """Once every resumed request has cleared the queue (re-admitted
+        into a slot, or already terminally handled — immediate-complete,
+        expired, faulted), stamp ``ds_serving_recoveries_total`` and the
+        ``last_recovery_ms`` gauge: the window clients of the crashed run
+        waited on the restarted engine before decoding resumed."""
+        if not self._resume_pending:
+            return
+        self._resume_pending = {u for u in self._resume_pending
+                                if u in self._ledger
+                                and u not in slots.slot_of_uid}
+        if not self._resume_pending:
+            self.telemetry.on_recover(
+                n_resumed, (self._clock() - resume_t0) * 1e3)
+
     def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
                     temperature, eos_token_id, speculate=False, gamma=0,
-                    adaptive=False):
+                    adaptive=False, faults=None, resume=()):
         c = self._config
         tel = self.telemetry
         alpha = c.frame_steps_ewma_alpha
         ewma = 0.0
         exhausted = False
         stats_synced = True     # device stat vector starts at zero
+        boundary = -1           # frame-boundary index (fault schedules key
+        #                         on it; == dispatched-frame index while
+        #                         rows are live)
+        resume_t0 = self._clock()
+        n_resumed = len(resume)
+        # ---- crash-recovery ingestion: re-admit the snapshot's requests
+        # ahead of any new arrival, re-prefilling prompt + committed tokens
+        # (the preemption fold) so greedy outputs are token-identical
+        # across the restart ----
+        for (uid, prompt, limit, temp, eos, dl_ms, generated, _ten, _pri,
+             _slo) in resume:
+            seq = self.state.get_or_create_sequence(uid)
+            seq.generated = list(generated)
+            seq.done = False
+            self._ledger_add(uid, prompt, limit, temp, eos, dl_ms,
+                             resumed_from=len(generated))
+            tel.on_enqueue(uid)
+            remaining = limit - len(generated)
+            if remaining <= 0:
+                # finished before the crashed run could yield it
+                out = np.asarray(seq.generated, np.int64)
+                self.state.flush_sequence(uid)
+                self._ledger.pop(uid, None)
+                tel.on_retire(uid)
+                yield uid, out
+                continue
+            folded = np.concatenate(
+                [np.asarray(prompt, np.int32),
+                 np.asarray(generated, np.int32)]) if generated else prompt
+            pending.append((uid, folded, remaining, temp, eos))
         while True:
+            boundary += 1
             if exhausted:
                 batch = None
                 ewma = (1.0 - alpha) * ewma
@@ -791,7 +1099,7 @@ class InferenceEngineV2:
                 # for this round, so a bad request can't strand blocks
                 # already reserved for earlier items in the same batch
                 for item in (batch or []):
-                    uid, toks, limit, temp, eos, _ten, _pri, _slo = \
+                    uid, toks, limit, temp, eos, _ten, _pri, _slo, dl_ms = \
                         self._norm_arrival(item, max_new_tokens, temperature,
                                            eos_token_id)
                     limit = self._validate_arrival(
@@ -799,13 +1107,25 @@ class InferenceEngineV2:
                         in_flight=uid in slots.slot_of_uid or
                         any(p[0] == uid for p in pending))
                     pending.append((uid, toks, limit, temp, eos))
+                    self._ledger_add(uid, toks, limit, temp, eos, dl_ms)
                     tel.on_enqueue(uid)
+            # ---- deadlines: expired work (queued or live) is cancelled
+            # BEFORE admission can spend a slot or blocks on it ----
+            self._expire_deadlines(slots, boundary, pending=pending)
             # ---- admission control (FIFO; blocks reserved for the whole
             # prompt + generation budget up front, so block tables never
             # grow mid-flight) ----
+            alloc_blocked = faults is not None \
+                and faults.kv_alloc_blocked(boundary)
+            if alloc_blocked and pending:
+                self._fault_event(
+                    "kv_alloc_failed", boundary,
+                    "injected KV-block allocation failure; admission "
+                    "deferred this boundary")
             admits = []
             blocks_before = self.kv.free_blocks
-            while pending and len(admits) < slots.free_slots():
+            while pending and not alloc_blocked \
+                    and len(admits) < slots.free_slots():
                 uid, toks, limit, temp, eos = pending[0]
                 seq = self.state.get_or_create_sequence(uid)
                 if not self.state.ensure_capacity(seq, len(toks) + limit + 1):
@@ -840,6 +1160,7 @@ class InferenceEngineV2:
                     max(len(a[1].blocks) for a in admits),
                     self.max_seq_len, self.max_blocks_per_seq)
                 slots.admit(admits)
+            self._note_recovery_progress(slots, resume_t0, n_resumed)
             if slots.live_count() == 0:
                 if exhausted and not pending:
                     return
@@ -857,12 +1178,18 @@ class InferenceEngineV2:
             if speculate:
                 draft = (self.draft_runner, self.draft_params, self.draft_kv,
                          gamma)
+            if faults is not None:
+                slots.set_poison(faults.poison_uids(boundary))
             with tel.frame_trace(width, cur_steps):
-                toks, emit = slots.run_frame(
-                    self.runner, self.params, self.kv, width, cur_steps,
-                    slots.all_greedy(), draft=draft)
+                toks, emit = self._run_frame_resilient(
+                    slots, width, cur_steps, slots.all_greedy(), draft,
+                    faults, boundary)
             stats_synced = self._sync_frame_stats(
                 slots, width, cur_steps, ewma, len(pending), stats_synced)
+            # quarantine BEFORE the host replay: a poisoned row's slot is
+            # freed here, so absorb neither emits its garbage tail nor
+            # retires it as finished
+            self._quarantine_nonfinite(slots, boundary)
             emissions, finished = slots.absorb(toks, emit, width)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
@@ -878,6 +1205,7 @@ class InferenceEngineV2:
                 out = np.asarray(seq.generated, np.int64)
                 slots.retire(uid)
                 self.state.flush_sequence(uid)
+                self._ledger.pop(uid, None)
                 tel.on_retire(uid)
                 yield uid, out
 
@@ -910,13 +1238,16 @@ class InferenceEngineV2:
 
     def _serve_loop_sched(self, slots, arrivals, sched, steps,
                           max_new_tokens, temperature, eos_token_id,
-                          speculate=False, gamma=0, adaptive=False):
+                          speculate=False, gamma=0, adaptive=False,
+                          faults=None, resume=()):
         """The scheduler-driven twin of ``_serve_loop``: same frame
         execution and retirement contract, but enqueue/admission flow
         through the ``RequestScheduler`` policy object, with an SLO
         control pass, optional preemption, and pressure-capped frame
         sizes at each boundary. All of it is host-side boundary work —
-        the frames themselves are untouched."""
+        the frames themselves are untouched. Deadline expiry runs BEFORE
+        the control pass, so expired work is cancelled before it can be
+        aged, preempted for, or admitted."""
         from .scheduler import (PRIORITY_NAMES, Request, normalize_priority)
         c = self._config
         tel = self.telemetry
@@ -924,7 +1255,47 @@ class InferenceEngineV2:
         ewma = 0.0
         exhausted = False
         stats_synced = True
+        boundary = -1
+        resume_t0 = self._clock()
+        n_resumed = len(resume)
+        # ---- crash-recovery ingestion (see _serve_loop): snapshot
+        # requests re-enter through the scheduler with their original
+        # class/tenant/slo, tokens folded for re-prefill ----
+        for (uid, prompt, limit, temp, eos, dl_ms, generated, tenant, prio,
+             slo_ms) in resume:
+            seq = self.state.get_or_create_sequence(uid)
+            seq.generated = list(generated)
+            seq.done = False
+            prio = normalize_priority(prio)
+            tenant = tenant or "default"
+            self._ledger_add(uid, prompt, limit, temp, eos, dl_ms,
+                             tenant=tenant, priority=PRIORITY_NAMES[prio],
+                             slo_ms=slo_ms, resumed_from=len(generated))
+            tel.on_enqueue(uid, tenant=tenant, pclass=PRIORITY_NAMES[prio])
+            remaining = limit - len(generated)
+            if remaining <= 0:
+                out = np.asarray(seq.generated, np.int64)
+                self.state.flush_sequence(uid)
+                self._ledger.pop(uid, None)
+                tel.on_retire(uid)
+                yield uid, out
+                continue
+            folded = np.concatenate(
+                [np.asarray(prompt, np.int32),
+                 np.asarray(generated, np.int32)]) if generated else \
+                np.asarray(prompt, np.int32)
+            shed = sched.submit(Request(
+                uid=uid, tokens=folded, limit=remaining, temp=temp,
+                eos=eos, tenant=tenant, priority=prio, slo_ms=slo_ms))
+            if shed is not None:
+                tel.on_shed(uid, shed.tenant, shed.priority, shed.reason)
+                self._ledger.pop(uid, None)
+                # unlike a shed NEW arrival (no descriptor yet), the resume
+                # ingestion created this descriptor above — drop it or the
+                # uid could never be reused
+                self.state.flush_sequence(uid)
         while True:
+            boundary += 1
             # ---- poll the arrival clock ----
             if exhausted:
                 batch = None
@@ -937,15 +1308,19 @@ class InferenceEngineV2:
                     batch = None
                 ewma = alpha * len(batch or []) + (1.0 - alpha) * ewma
                 for item in (batch or []):
-                    uid, toks, limit, temp, eos, tenant, prio, slo_ms = \
-                        self._norm_arrival(item, max_new_tokens, temperature,
-                                           eos_token_id)
+                    uid, toks, limit, temp, eos, tenant, prio, slo_ms, \
+                        dl_ms = self._norm_arrival(
+                            item, max_new_tokens, temperature, eos_token_id)
                     limit = self._validate_arrival(
                         uid, toks, limit,
                         in_flight=uid in slots.slot_of_uid or
                         sched.is_queued(uid))
                     prio = normalize_priority(prio)
                     tenant = tenant or "default"
+                    self._ledger_add(uid, toks, limit, temp, eos, dl_ms,
+                                     tenant=tenant,
+                                     priority=PRIORITY_NAMES[prio],
+                                     slo_ms=slo_ms)
                     tel.on_enqueue(uid, tenant=tenant,
                                    pclass=PRIORITY_NAMES[prio])
                     shed = sched.submit(Request(
@@ -955,6 +1330,10 @@ class InferenceEngineV2:
                     if shed is not None:
                         tel.on_shed(uid, shed.tenant, shed.priority,
                                     shed.reason)
+                        self._ledger.pop(uid, None)
+            # ---- deadlines: cancel expired work (queued or live) BEFORE
+            # it can be aged, preempted for, or admitted ----
+            self._expire_deadlines(slots, boundary, sched=sched)
             # ---- SLO control pass: age queues, refill fair-share credit,
             # recompute pressure, shed best-effort work under critical
             # pressure (structured reasons land in sched.shed_log) ----
@@ -966,6 +1345,7 @@ class InferenceEngineV2:
                 # failed capacity probe — drop it, or the uid could never
                 # be reused
                 self.state.flush_sequence(shed.uid)
+                self._ledger.pop(shed.uid, None)
             tel.gauges["slo_risk"] = round(sched.risk, 4)
             # ---- frame-boundary preemption: make room for a queued
             # interactive arrival by evicting a lower-priority live row ----
@@ -977,6 +1357,13 @@ class InferenceEngineV2:
                     self._evict_to_queue(uid, slots, sched)
             # ---- policy admission (strict priority + fair share) ----
             blocks_before = self.kv.free_blocks
+            alloc_blocked = faults is not None \
+                and faults.kv_alloc_blocked(boundary)
+            if alloc_blocked and sched.queued_count():
+                self._fault_event(
+                    "kv_alloc_failed", boundary,
+                    "injected KV-block allocation failure; admission "
+                    "deferred this boundary")
 
             def try_reserve(req):
                 seq = self.state.get_or_create_sequence(req.uid)
@@ -986,13 +1373,14 @@ class InferenceEngineV2:
                 return seq
 
             admits = []
-            for req, seq in sched.pick(slots.free_slots(), try_reserve,
-                                       live_count=slots.live_count()):
-                seq.done = False
-                req.gen_base = len(seq.generated)
-                admits.append((req.uid, seq, req.tokens, req.limit,
-                               req.temp, req.eos))
-                tel.on_admit(req.uid)
+            if not alloc_blocked:
+                for req, seq in sched.pick(slots.free_slots(), try_reserve,
+                                           live_count=slots.live_count()):
+                    seq.done = False
+                    req.gen_base = len(seq.generated)
+                    admits.append((req.uid, seq, req.tokens, req.limit,
+                                   req.temp, req.eos))
+                    tel.on_admit(req.uid)
             if sched.queued_count():
                 tel.on_defer(
                     queue_depth=sched.queued_count(),
@@ -1006,6 +1394,7 @@ class InferenceEngineV2:
                     max(len(a[1].blocks) for a in admits),
                     self.max_seq_len, self.max_blocks_per_seq)
                 slots.admit(admits)
+            self._note_recovery_progress(slots, resume_t0, n_resumed)
             if slots.live_count() == 0:
                 if exhausted and not sched.queued_count():
                     return
@@ -1024,13 +1413,16 @@ class InferenceEngineV2:
             if speculate:
                 draft = (self.draft_runner, self.draft_params, self.draft_kv,
                          gamma)
+            if faults is not None:
+                slots.set_poison(faults.poison_uids(boundary))
             with tel.frame_trace(width, cur_steps):
-                toks, emit = slots.run_frame(
-                    self.runner, self.params, self.kv, width, cur_steps,
-                    slots.all_greedy(), draft=draft)
+                toks, emit = self._run_frame_resilient(
+                    slots, width, cur_steps, slots.all_greedy(), draft,
+                    faults, boundary)
             stats_synced = self._sync_frame_stats(
                 slots, width, cur_steps, ewma, sched.queued_count(),
                 stats_synced)
+            self._quarantine_nonfinite(slots, boundary, sched=sched)
             emissions, finished = slots.absorb(toks, emit, width)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
@@ -1045,6 +1437,7 @@ class InferenceEngineV2:
                 slots.retire(uid)
                 self.state.flush_sequence(uid)
                 sched.on_retire(uid)
+                self._ledger.pop(uid, None)
                 tel.on_retire(uid)
                 yield uid, out
 

@@ -1,0 +1,256 @@
+"""Serving resilience: fault injection, fault taxonomy, crash recovery.
+
+The frame loop (``engine_v2.serve``) keeps the host out of the decode path,
+which also concentrates failure: one NaN row, one hung frame, or one engine
+crash used to take down every in-flight request. This module is the failure
+story, in four pieces (README "Fault tolerance & chaos testing"):
+
+1. **Fault taxonomy** — every abnormal request retirement is a structured
+   ``FaultReason`` (kind, frame, partial output) appended to the engine's
+   bounded ``fault_log`` and counted in ``ds_serving_faults_total{kind=}``:
+
+   * ``poison_row``       — a row's logits went non-finite (detected by the
+     in-graph per-row finite-check riding the frame carry); the row is
+     quarantined via the preemption eviction path and the REST OF THE BATCH
+     KEEPS DECODING — a batch must never die for one request.
+   * ``deadline_expired`` — the request's ``deadline_ms`` passed at a frame
+     boundary (queued or live); its KV blocks are freed and a timeout
+     retirement is recorded.
+   * ``dispatch_failed``  — a frame dispatch raised and bounded retry with
+     exponential backoff could not recover; the engine snapshots its
+     host-side request ledger (``last_crash_snapshot``) before the error
+     propagates, so a restarted engine can ``serve(..., resume_from=)``.
+   * ``dispatch_retry``   — one transient dispatch failure absorbed by the
+     retry loop (counted, not retired: the carry is intact, so the retried
+     frame is token-identical).
+   * ``slow_frame``       — the frame wall-clock watchdog fired
+     (``watchdog_frame_ms``); counted and warned, never killed (a jit
+     cannot be safely interrupted mid-flight — deadlines at the NEXT
+     boundary are the recovery mechanism for work stuck behind it).
+   * ``kv_alloc_failed``  — a KV-block allocation was (injected as) failed;
+     admission defers, which is the graceful path the chaos tests pin.
+
+2. **Deterministic fault injection** — ``FaultInjector`` drives a scripted
+   schedule of ``FaultSpec``s keyed ONLY by frame index and uid (no clocks,
+   no randomness), threaded through the real code paths: dispatch
+   exceptions raise before the donated carry is consumed (so retry is
+   exact), poison sets a per-row device flag that the compiled frame turns
+   into NaN logits (so quarantine exercises the real in-graph detector),
+   KV-alloc failures gate the real admission probe, and slow frames sleep
+   inside the watchdog's measurement window.
+
+3. **Crash recovery** — ``engine.snapshot_serving_state()`` serializes the
+   host-side request ledger (original prompts + committed tokens +
+   scheduling metadata, all host mirrors — zero device reads) and
+   ``serve(..., resume_from=snapshot)`` re-admits every in-flight request
+   by re-prefilling prompt + committed tokens, the PR-4 preemption
+   machinery, so greedy outputs are token-identical across the crash.
+
+4. **Recovery telemetry** — ``ds_serving_quarantined_total``,
+   ``ds_serving_deadline_expired_total``, ``ds_serving_recoveries_total``,
+   ``ds_serving_frame_retries_total``, ``ds_serving_slow_frames_total``
+   counters and the ``ds_serving_last_recovery_ms`` gauge.
+
+Everything host-side runs at frame boundaries; the only in-graph addition
+is the finite-check (a per-step reduction on logits the frame already
+computed) and the poison select — the transfer-guard chaos test pins that
+none of it adds a device→host transfer inside a frame.
+"""
+
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+FAULT_KINDS = ("poison_row", "deadline_expired", "dispatch_failed",
+               "dispatch_retry", "slow_frame", "kv_alloc_failed")
+
+INJECTABLE_KINDS = ("dispatch_exception", "kv_alloc_fail", "poison_row",
+                    "slow_frame")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by ``FaultInjector`` at an injection point (dispatch). The
+    retry loop treats it like any other dispatch failure — chaos tests
+    exercise the REAL recovery path, not a mock of it."""
+
+
+class FrameDispatchError(RuntimeError):
+    """A serving frame could not be dispatched within the retry budget.
+    By the time this propagates, ``engine.last_crash_snapshot`` holds the
+    host-side request ledger — ``serve(..., resume_from=)`` on a fresh (or
+    the same) engine resumes every in-flight request."""
+
+
+@dataclasses.dataclass
+class FaultReason:
+    """Structured record of one abnormal request retirement (or absorbed
+    fault event), appended to ``engine.fault_log``."""
+    uid: int
+    kind: str                  # one of FAULT_KINDS
+    frame: int                 # frame index at detection
+    detail: str = ""
+    tokens_emitted: int = 0    # committed tokens at the fault
+    partial: Optional[List[int]] = None   # committed output, if any
+    tenant: Optional[str] = None
+    priority: Optional[str] = None
+
+
+@dataclasses.dataclass
+class LedgerEntry:
+    """One accepted, not-yet-retired request in the engine's host-side
+    serving ledger — the unit of crash recovery AND the authoritative
+    cleanup set on generator abandonment (a request is added at enqueue and
+    dropped at retire/shed/fault, so even a row caught mid-transit between
+    eviction and re-admission is always covered)."""
+    uid: int
+    prompt: List[int]          # ORIGINAL prompt (preemption folds happen in
+                               # the scheduler's Request, never here)
+    limit: int                 # ORIGINAL generation budget
+    temp: float
+    eos: Optional[int]
+    deadline_at: Optional[float] = None    # absolute monotonic, None = none
+    tenant: Optional[str] = None
+    priority: Optional[object] = None      # class name / int, as submitted
+    slo_ms: Optional[float] = None
+    resumed_from: int = 0      # committed tokens carried across a resume
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scripted fault. Deterministic: keyed by the serve loop's
+    FRAME-BOUNDARY index (``frame``) and, for poison, uid. The boundary
+    index increments at every arrival-poll/admission pass of the loop —
+    including idle polls where nothing is live and no frame is dispatched
+    (this keeps an injected KV-alloc outage from stalling the boundary
+    clock it is keyed on). While rows are live it coincides with the
+    dispatched-frame index, so for the saturated schedules chaos tests use
+    the two readings are the same; with arrival gaps, count boundaries,
+    not frames.
+
+    * ``dispatch_exception``: the first ``times`` dispatch attempts at
+      frame ``frame`` raise ``InjectedFault`` (before the donated carry is
+      consumed, so a retry re-runs the identical frame). ``times`` within
+      the engine's retry budget => transient; beyond it => fatal crash.
+    * ``kv_alloc_fail``: admission's KV reservation fails at boundaries
+      ``frame .. frame + times - 1`` — arrivals defer, nothing crashes.
+    * ``poison_row``: at the boundary before frame ``frame``, set row
+      ``uid``'s device poison flag; the compiled frame NaNs its logits and
+      the in-graph finite-check trips. One-shot.
+    * ``slow_frame``: sleep ``seconds`` before dispatching frame ``frame``
+      (first attempt only), inside the watchdog's measurement window.
+    """
+    kind: str
+    frame: int
+    times: int = 1
+    uid: Optional[int] = None
+    seconds: float = 0.0
+
+    def __post_init__(self):
+        if self.kind not in INJECTABLE_KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}: expected "
+                             f"one of {INJECTABLE_KINDS}")
+        if self.frame < 0 or self.times < 1:
+            raise ValueError("fault frame must be >= 0 and times >= 1")
+        if self.kind == "poison_row" and self.uid is None:
+            raise ValueError("poison_row needs a target uid")
+        if self.kind == "slow_frame" and self.seconds < 0:
+            raise ValueError("slow_frame seconds must be >= 0")
+
+
+class FaultInjector:
+    """Schedule-driven fault injection for ``serve(..., faults=)``.
+
+    Specs may be ``FaultSpec`` instances or plain dicts with the same
+    fields. One injector drives one serve run at a time (``begin_serve``
+    rearms the schedule); ``fired`` records every injection that actually
+    happened, in order, for assertions and the chaos bench."""
+
+    def __init__(self, schedule, sleep=time.sleep):
+        self.schedule = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
+                         for s in schedule]
+        self._sleep = sleep
+        self.fired: List[Dict] = []
+        self.begin_serve()
+
+    def begin_serve(self) -> None:
+        """Rearm every spec (called by ``serve()`` — the schedule is
+        deterministic per run, so two identical runs inject identically)."""
+        self._dispatch_fired = {id(s): 0 for s in self.schedule}
+        self._poison_done = {id(s): False for s in self.schedule}
+        self._slept = set()
+
+    def _fire(self, spec: FaultSpec, frame: int, **extra) -> None:
+        self.fired.append({"kind": spec.kind, "frame": frame, **extra})
+
+    def poison_uids(self, frame: int) -> List[int]:
+        """uids whose device poison flag should be set before this frame."""
+        out = []
+        for s in self.schedule:
+            if s.kind == "poison_row" and s.frame == frame \
+                    and not self._poison_done[id(s)]:
+                self._poison_done[id(s)] = True
+                self._fire(s, frame, uid=s.uid)
+                out.append(s.uid)
+        return out
+
+    def kv_alloc_blocked(self, frame: int) -> bool:
+        """True when this boundary's KV reservations should fail."""
+        for s in self.schedule:
+            if s.kind == "kv_alloc_fail" and \
+                    s.frame <= frame < s.frame + s.times:
+                self._fire(s, frame)
+                return True
+        return False
+
+    def before_dispatch(self, frame: int, attempt: int) -> None:
+        """Runs inside the engine's dispatch guard: may sleep (slow_frame)
+        or raise ``InjectedFault`` (dispatch_exception) BEFORE the jit call
+        touches the donated carry — a retried frame is token-identical."""
+        for s in self.schedule:
+            if s.kind == "slow_frame" and s.frame == frame \
+                    and attempt == 0 and id(s) not in self._slept:
+                self._slept.add(id(s))
+                self._fire(s, frame, seconds=s.seconds)
+                self._sleep(s.seconds)
+        for s in self.schedule:
+            if s.kind == "dispatch_exception" and s.frame == frame \
+                    and self._dispatch_fired[id(s)] < s.times:
+                self._dispatch_fired[id(s)] += 1
+                self._fire(s, frame, attempt=attempt)
+                raise InjectedFault(
+                    f"injected dispatch failure (frame={frame} "
+                    f"attempt={attempt} "
+                    f"{self._dispatch_fired[id(s)]}/{s.times})")
+
+
+def snapshot_ledger(ledger: Dict[int, LedgerEntry], seqs: Dict,
+                    clock) -> Dict:
+    """Serialize the host-side request ledger to a plain-python snapshot
+    (JSON-serializable ints/lists only — safe to persist across processes).
+
+    Per request: the ORIGINAL prompt, every committed token (the host
+    mirror ``seq.generated`` — tokens from a frame that never returned are
+    simply re-generated by the resume's re-prefill, greedy-identically),
+    the remaining deadline budget, and the scheduling metadata. Zero device
+    reads: everything here is host state the serve loops already maintain.
+    """
+    now = clock()
+    reqs = []
+    for uid, ent in ledger.items():
+        seq = seqs.get(uid)
+        generated = [int(t) for t in seq.generated] if seq is not None else []
+        reqs.append({
+            "uid": int(uid),
+            "prompt": [int(t) for t in ent.prompt],
+            "generated": generated,
+            "limit": int(ent.limit),
+            "temp": float(ent.temp),
+            "eos": None if ent.eos is None else int(ent.eos),
+            "deadline_remaining_ms": (
+                None if ent.deadline_at is None
+                else max(0.0, (ent.deadline_at - now) * 1e3)),
+            "tenant": ent.tenant,
+            "priority": ent.priority,
+            "slo_ms": ent.slo_ms,
+        })
+    return {"version": 1, "requests": reqs}

@@ -316,13 +316,14 @@ class PagedModelRunner:
                                           block_tables, width, greedy)
 
             zero = jnp.zeros((b,), jnp.int32)
-            carry = (zero, zero, zero, jnp.zeros((b,), bool),
+            no = jnp.zeros((b,), bool)
+            carry = (zero, zero, zero, no, no, no,
                      jnp.zeros((N_STATS,), jnp.int32), rng, kpool, vpool)
             carry, (toks_w, emit_w) = jax.lax.scan(
                 make_body(chunk), carry, None, length=wide_steps)
             carry, (toks_n, emit_n) = jax.lax.scan(
                 make_body(1), carry, None, length=narrow_steps)
-            kpool, vpool = carry[6], carry[7]
+            kpool, vpool = carry[8], carry[9]
             return (jnp.concatenate([toks_w, toks_n]),
                     jnp.concatenate([emit_w, emit_n]), kpool, vpool)
 
@@ -337,11 +338,12 @@ class PagedModelRunner:
         fwd = self._forward
 
         @functools.partial(jax.jit,
-                           donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14),
+                           donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15,
+                                           16),
                            static_argnames=("width", "steps", "greedy"))
         def loop(params, prompts, prompt_lens, limits, eos_ids, temps, tables,
-                 cached, produced, last_tok, done, stats, rng, kpool, vpool,
-                 width, steps, greedy):
+                 cached, produced, last_tok, done, poison, nonfinite, stats,
+                 rng, kpool, vpool, width, steps, greedy):
             """One K-step serving FRAME: the resumable generalization of
             ``mixed_loop``. All per-slot state is carry-IN/carry-OUT, so the
             host only touches the loop at frame boundaries (admit arrivals,
@@ -361,13 +363,16 @@ class PagedModelRunner:
             place and the outputs ARE the next frame's inputs. ``stats`` is
             the (N_STATS,) in-graph telemetry accumulator — monotonically
             increasing device counters that surface only at frame
-            boundaries (see ``telemetry.py``).
+            boundaries (see ``telemetry.py``). ``poison``/``nonfinite``
+            (B,) bools are the fault-injection flag and the per-row
+            finite-check latch (``faults.py``): both ride the donated
+            carry, so arming a fault or detecting a NaN never retraces.
             """
             body = _serving_scan_body(fwd, params, prompts, prompt_lens,
                                       limits, eos_ids, temps, tables, width,
                                       greedy)
-            carry = (cached, produced, last_tok, done, stats, rng, kpool,
-                     vpool)
+            carry = (cached, produced, last_tok, done, poison, nonfinite,
+                     stats, rng, kpool, vpool)
             carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
             return (toks, emit) + carry
 
@@ -384,12 +389,12 @@ class PagedModelRunner:
 
         @functools.partial(jax.jit,
                            donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16,
-                                           17, 18),
+                                           17, 18, 19, 20),
                            static_argnames=("width", "steps", "greedy", "gamma"))
         def loop(params, draft_params, prompts, prompt_lens, limits, eos_ids,
                  temps, tables, cached, produced, last_tok, penult, done,
-                 stats, rng, kpool, vpool, dkpool, dvpool, width, steps,
-                 greedy, gamma):
+                 poison, nonfinite, stats, rng, kpool, vpool, dkpool, dvpool,
+                 width, steps, greedy, gamma):
             """Speculative K-step serving frame: ``frame_loop`` with a second
             model riding the carry. Wide (prefill) frames run the target body
             unchanged while the draft ingests the same chunks (its paged KV
@@ -408,8 +413,8 @@ class PagedModelRunner:
                                       limits, eos_ids, temps, tables, width,
                                       greedy,
                                       draft=(draft_fwd, draft_params, gamma))
-            carry = (cached, produced, last_tok, penult, done, stats, rng,
-                     kpool, vpool, dkpool, dvpool)
+            carry = (cached, produced, last_tok, penult, done, poison,
+                     nonfinite, stats, rng, kpool, vpool, dkpool, dvpool)
             carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
             return (toks, emit) + carry
 
@@ -447,7 +452,8 @@ class PagedModelRunner:
                                                  gamma))
 
             zero = jnp.zeros((b,), jnp.int32)
-            carry = (zero, zero, zero, zero, jnp.zeros((b,), bool),
+            no = jnp.zeros((b,), bool)
+            carry = (zero, zero, zero, zero, no, no, no,
                      jnp.zeros((N_STATS,), jnp.int32), rng,
                      kpool, vpool, dkpool, dvpool)
             carry, (toks_w, emit_w) = jax.lax.scan(
@@ -456,7 +462,7 @@ class PagedModelRunner:
                 make_body(1), carry, None, length=narrow_steps)
             return (jnp.concatenate([toks_w, toks_n]),
                     jnp.concatenate([emit_w, emit_n]),
-                    carry[7], carry[8], carry[9], carry[10])
+                    carry[9], carry[10], carry[11], carry[12])
 
         return loop
 
@@ -513,8 +519,11 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
     """Shared scan-step for ``mixed_loop`` and ``frame_loop`` — the in-graph
     SplitFuse scheduling arithmetic lives in exactly one place.
 
-    Carry: (cached, produced, last_tok, done, stats, rng, kpool, vpool). Per
-    step, a
+    Carry: (cached, produced, last_tok, done, poison, nonfinite, stats, rng,
+    kpool, vpool) — ``poison`` is the fault-injection flag (NaNs the row's
+    logits when set, see ``_inject_poison``) and ``nonfinite`` the per-row
+    finite-check latch (``_finite_check``), both read/reset only at frame
+    boundaries. Per step, a
     row with ``cached < prompt_lens`` prefills (consumes up to ``width``
     prompt tokens); a row past its prompt with ``produced < limits`` decodes
     one token; ``done`` rows (in-graph EOS) and rows at their limit freeze —
@@ -543,12 +552,14 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                                eos_ids, temps, tables, width, greedy, *draft)
 
     def body(carry, _):
-        cached, produced, last_tok, done, stats, rng, kpool, vpool = carry
+        (cached, produced, last_tok, done, poison, nonfinite, stats, rng,
+         kpool, vpool) = carry
         prefilling, active, w, ids, positions = _wide_plan(
             prompts, prompt_lens, limits, width, cached, produced, last_tok,
             done)
         logits, kpool, vpool = fwd(params, ids, positions, tables, w,
                                    kpool, vpool)
+        logits = _inject_poison(logits, poison)
         if greedy:
             nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         else:
@@ -557,16 +568,44 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
                                           prompt_lens, eos_ids, nxt,
                                           last_tok, done)
+        emit, done, nonfinite = _finite_check(logits, active, emit, done,
+                                              nonfinite)
         stats = stats + _stat_delta(
             emitted=emit, active=active,
             prefill_toks=jnp.where(prefilling, w, 0),
             eos=emit & (nxt == eos_ids),
             target_fwd=active & ~prefilling)
         return ((cached + w, produced + emit.astype(jnp.int32),
-                 last_tok, done, stats, rng, kpool, vpool),
+                 last_tok, done, poison, nonfinite, stats, rng, kpool,
+                 vpool),
                 (jnp.where(emit, nxt, -1), emit))
 
     return body
+
+
+def _inject_poison(logits, poison):
+    """Fault-injection hook for the in-graph finite-check: rows whose
+    device ``poison`` flag is set get NaN logits, exercising the REAL
+    quarantine path (detection, freeze, boundary eviction). The flag is
+    normally all-False, so this compiles to one cheap select — always part
+    of the frame program, so arming a fault schedule never retraces."""
+    pad = (1,) * (logits.ndim - 1)
+    return jnp.where(poison.reshape((-1,) + pad),
+                     jnp.asarray(jnp.nan, logits.dtype), logits)
+
+
+def _finite_check(logits, active, emit, done, nonfinite):
+    """The in-graph per-row poison detector: an active row whose logits
+    contain a non-finite value (NaN/inf — numeric blowup or injected) stops
+    emitting THIS step, freezes for the rest of the frame, and latches its
+    ``nonfinite`` carry flag, which the host reads at the frame boundary
+    (one tiny (B,) read, never inside the frame) to quarantine the row via
+    the eviction path. Sibling rows' arithmetic is untouched — the batch
+    never dies for one request."""
+    axes = tuple(range(1, logits.ndim))
+    bad = active & ~jnp.all(jnp.isfinite(logits), axis=axes)
+    emit = emit & ~(bad if emit.ndim == 1 else bad[:, None])
+    return emit, done | bad, nonfinite | bad
 
 
 def _stat_delta(emitted=None, active=None, prefill_toks=None, eos=None,
@@ -625,8 +664,11 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                     gamma):
     """Speculative variant of the serving scan step (see
     ``_serving_scan_body``). Carry: (cached, produced, last_tok, penult,
-    done, stats, rng, kpool, vpool, dkpool, dvpool); emissions are
-    (B, gamma+1).
+    done, poison, nonfinite, stats, rng, kpool, vpool, dkpool, dvpool);
+    emissions are (B, gamma+1). The finite-check watches the TARGET's
+    verify logits (a draft gone non-finite only garbles proposals, which
+    verification rejects; a non-finite target is unrecoverable for the
+    row and quarantines it).
 
     Invariants at every step boundary, per row: target KV is committed for
     positions [0, cached) (``cached`` IS the committed watermark — pool
@@ -643,14 +685,15 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
 
     if width > 1:
         def body(carry, _):
-            (cached, produced, last_tok, penult, done, stats, rng,
-             kpool, vpool, dkpool, dvpool) = carry
+            (cached, produced, last_tok, penult, done, poison, nonfinite,
+             stats, rng, kpool, vpool, dkpool, dvpool) = carry
             b = cached.shape[0]
             prefilling, active, w, ids, positions = _wide_plan(
                 prompts, prompt_lens, limits, width, cached, produced,
                 last_tok, done)
             logits, kpool, vpool = fwd(params, ids, positions, tables, w,
                                        kpool, vpool)
+            logits = _inject_poison(logits, poison)
             # the draft ingests the identical chunk: prefill rows stream the
             # prompt into the draft pools, decode rows (w=1 inside a wide
             # mixed frame) keep the draft cache on the committed prefix
@@ -671,6 +714,8 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
             emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
                                               prompt_lens, eos_ids, nxt,
                                               last_tok, done)
+            emit, done, nonfinite = _finite_check(logits, active, emit,
+                                                  done, nonfinite)
             penult = jnp.where(emit, new_penult, penult)
             toks_k = jnp.full((b, k_out), -1, jnp.int32).at[:, 0].set(
                 jnp.where(emit, nxt, -1))
@@ -684,15 +729,16 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                 prefill_toks=jnp.where(prefilling, w, 0),
                 eos=emit & (nxt == eos_ids))
             return ((cached + w, produced + emit.astype(jnp.int32), last_tok,
-                     penult, done, stats, rng, kpool, vpool, dkpool, dvpool),
+                     penult, done, poison, nonfinite, stats, rng, kpool,
+                     vpool, dkpool, dvpool),
                     (toks_k, emit_k))
 
         return body
 
     # ---- width 1: the speculative decode step ----
     def body(carry, _):
-        (cached, produced, last_tok, penult, done, stats, rng,
-         kpool, vpool, dkpool, dvpool) = carry
+        (cached, produced, last_tok, penult, done, poison, nonfinite, stats,
+         rng, kpool, vpool, dkpool, dvpool) = carry
         # speculative frames are scheduled only when no slot prefills; a
         # prefilling row here would freeze (serve() never produces one)
         active = ~done & (cached >= prompt_lens) & (produced < limits)
@@ -744,6 +790,7 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         pos_v = pos_of(cached[:, None] + koffs[None, :])
         tlogits, kpool, vpool = fwd(params, ids_v, pos_v, tables,
                                     k_out * av, kpool, vpool, all_logits=True)
+        tlogits = _inject_poison(tlogits, poison)
         n_acc, repl = speculative_verify_per_row(tlogits, dlogits, q, temps,
                                                  rng=rng_v)
 
@@ -755,6 +802,8 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         emit = (active[:, None] & (koffs[None, :] <= n_acc[:, None])
                 & (produced[:, None] + koffs[None, :] < limits[:, None])
                 & (eos_before == 0))
+        emit, done, nonfinite = _finite_check(tlogits, active, emit, done,
+                                              nonfinite)
         m = jnp.sum(emit.astype(jnp.int32), axis=1)
         seq_toks = jnp.concatenate([last_tok[:, None], e], axis=1)
         new_last = jnp.take_along_axis(seq_toks, m[:, None], axis=1)[:, 0]
@@ -770,8 +819,8 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
             emitted=emit, active=active, eos=emit & is_eos,
             target_fwd=active, drafted=gamma * active.astype(jnp.int32),
             accepted=emit[:, 1:])
-        return ((cached + m, produced + m, last_tok, penult, done, stats, rng,
-                 kpool, vpool, dkpool, dvpool),
+        return ((cached + m, produced + m, last_tok, penult, done, poison,
+                 nonfinite, stats, rng, kpool, vpool, dkpool, dvpool),
                 (jnp.where(emit, e, -1), emit))
 
     return body

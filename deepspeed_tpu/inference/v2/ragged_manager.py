@@ -129,6 +129,11 @@ class DeviceSlotTable:
         self.last_tok = zi(n_slots)
         self.penult = zi(n_slots)          # speculative carry: token at cached-1
         self.done = jnp.ones((n_slots,), bool)
+        # fault-injection flag (frame NaNs the row's logits while set) and
+        # the in-graph finite-check latch — both ride the donated carry
+        # like stats, so arming a fault or catching a NaN never retraces
+        self.poison = jnp.zeros((n_slots,), bool)
+        self.nonfinite = jnp.zeros((n_slots,), bool)
         self.rng = rng
         # in-graph telemetry counters (telemetry.N_STATS): accumulate on the
         # donated carry; the host reads AND rebases them only at frame
@@ -237,6 +242,10 @@ class DeviceSlotTable:
         self.last_tok = self.last_tok.at[idx].set(zero)
         self.penult = self.penult.at[idx].set(zero)
         self.done = self.done.at[idx].set(False)
+        # a slot freed by quarantine must not hand its poison/latch state
+        # to the next tenant of the row
+        self.poison = self.poison.at[idx].set(False)
+        self.nonfinite = self.nonfinite.at[idx].set(False)
 
     def retire(self, uid: int) -> None:
         """Free the slot on the host side; the device row is already frozen
@@ -262,6 +271,10 @@ class DeviceSlotTable:
         idx = jnp.asarray([slot], jnp.int32)
         self.done = self.done.at[idx].set(True)
         self.limits = self.limits.at[idx].set(0)
+        # quarantine evicts through here too: clear the fault flags so the
+        # freed slot's latch cannot re-report at later boundaries
+        self.poison = self.poison.at[idx].set(False)
+        self.nonfinite = self.nonfinite.at[idx].set(False)
 
     # ---------------- frame execution + host replay ----------------
 
@@ -277,23 +290,24 @@ class DeviceSlotTable:
         as a device array."""
         if draft is None:
             (toks, emit, self.cached, self.produced, self.last_tok, self.done,
-             self.stats, self.rng, kv.k, kv.v) = runner.frame_loop(
+             self.poison, self.nonfinite, self.stats, self.rng, kv.k,
+             kv.v) = runner.frame_loop(
                 params, self.prompts, self.prompt_lens, self.limits,
                 self.eos_ids, self.temps, self.tables, self.cached,
-                self.produced, self.last_tok, self.done, self.stats,
-                self.rng, kv.k, kv.v,
+                self.produced, self.last_tok, self.done, self.poison,
+                self.nonfinite, self.stats, self.rng, kv.k, kv.v,
                 width=width, steps=steps, greedy=greedy)
             return toks, emit
         draft_runner, draft_params, draft_kv, gamma = draft
         (toks, emit, self.cached, self.produced, self.last_tok, self.penult,
-         self.done, self.stats, self.rng, kv.k, kv.v, draft_kv.k,
-         draft_kv.v) = runner.frame_loop_spec(
+         self.done, self.poison, self.nonfinite, self.stats, self.rng, kv.k,
+         kv.v, draft_kv.k, draft_kv.v) = runner.frame_loop_spec(
             draft_runner, params, draft_params, self.prompts,
             self.prompt_lens, self.limits, self.eos_ids, self.temps,
             self.tables, self.cached, self.produced, self.last_tok,
-            self.penult, self.done, self.stats, self.rng, kv.k, kv.v,
-            draft_kv.k, draft_kv.v, width=width, steps=steps, greedy=greedy,
-            gamma=gamma)
+            self.penult, self.done, self.poison, self.nonfinite, self.stats,
+            self.rng, kv.k, kv.v, draft_kv.k, draft_kv.v, width=width,
+            steps=steps, greedy=greedy, gamma=gamma)
         return toks, emit
 
     def run_frame(self, runner, params, kv, width: int, steps: int,
@@ -305,6 +319,28 @@ class DeviceSlotTable:
         toks, emit = self.dispatch_frame(runner, params, kv, width, steps,
                                          greedy, draft=draft)
         return np.asarray(toks), np.asarray(emit)
+
+    def set_poison(self, uids: List[int]) -> None:
+        """Arm the device poison flag for live rows (fault injection): the
+        next frame NaNs their logits in-graph, exercising the REAL
+        finite-check → quarantine path. One tiny host→device write at the
+        boundary; unknown/retired uids are ignored (the fault raced a
+        normal retirement — nothing to poison)."""
+        rows = [self.slot_of_uid[u] for u in uids if u in self.slot_of_uid]
+        if not rows:
+            return
+        idx = jnp.asarray(rows, jnp.int32)
+        self.poison = self.poison.at[idx].set(True)
+
+    def nonfinite_uids(self) -> List[int]:
+        """Frame-boundary read of the in-graph finite-check latch: live
+        uids whose logits went non-finite during the last frame (candidates
+        for quarantine). One tiny (B,) device→host transfer per boundary —
+        outside the frame, like ``stats_delta`` — and the ONLY read the
+        poison-quarantine machinery performs."""
+        flags = np.asarray(self.nonfinite)
+        return [int(self.uid_of_slot[i]) for i in range(self.n_slots)
+                if flags[i] and self.uid_of_slot[i] >= 0]
 
     def stats_delta(self) -> np.ndarray:
         """Frame-boundary read of the in-graph counters: returns the

@@ -288,6 +288,24 @@ class RequestScheduler:
         self._queues.setdefault(key, deque()).appendleft(req)
         self._queued_uids.add(req.uid)
 
+    def cancel(self, uid: int) -> Optional[Request]:
+        """Remove a QUEUED request outright (deadline expiry — the engine
+        enforces ``deadline_ms`` at frame boundaries and cancels expired
+        work here BEFORE it can be preempted for, aged, or admitted).
+        Returns the removed request, or None if ``uid`` is not queued.
+        No shed record: the caller retires it with a structured
+        ``FaultReason`` instead."""
+        if uid not in self._queued_uids:
+            return None
+        for q in self._queues.values():
+            for r in q:
+                if r.uid == uid:
+                    q.remove(r)
+                    self._queued_uids.discard(uid)
+                    return r
+        self._queued_uids.discard(uid)     # defensive: set/queue desync
+        return None
+
     def _shed(self, req: Request, reason: str) -> ShedReason:
         slo = self._telemetry.slo_view() if self._telemetry is not None \
             else {}

@@ -228,13 +228,19 @@ class ServingTelemetry:
         self.counters.update(requests_enqueued=0, requests_admitted=0,
                              requests_retired=0, admission_deferrals=0,
                              requests_shed=0, requests_preempted=0,
-                             frames=0, slot_steps_capacity=0)
+                             frames=0, slot_steps_capacity=0,
+                             # fault-tolerance surface (faults.py): total
+                             # faults (kind-labeled), plus the per-kind
+                             # headline counters the SLO dashboard plots
+                             faults=0, quarantined=0, deadline_expired=0,
+                             recoveries=0, frame_retries=0, slow_frames=0)
         self.gauges: Dict[str, float] = {
             "live_slots": 0, "slot_count": 0, "queue_depth": 0,
             "kv_blocks_in_use": 0, "kv_blocks_in_use_peak": 0,
             "kv_blocks_total": 0,
             "occupancy": 0.0, "recompiled_programs": 0,
             "slo_risk": 0.0, "frame_steps_chosen": 0,
+            "last_recovery_ms": 0.0,
         }
         self.hists: Dict[str, LogBucketHistogram] = {
             n: LogBucketHistogram() for n in self.HIST_NAMES}
@@ -401,6 +407,34 @@ class ServingTelemetry:
             self._inc_labeled("requests_preempted",
                               (("class", pclass or "unknown"),
                                ("tenant", tenant or "unknown")))
+
+    def on_fault(self, kind: str, uid: Optional[int] = None) -> None:
+        """One fault event (``faults.FAULT_KINDS``). Like ``on_shed``/
+        ``on_defer``, deliberately NOT gated on ``enabled``: a fault is a
+        client-visible failure action, and losing its count is the failure
+        mode telemetry exists to prevent. ``uid`` (for request-terminal
+        kinds) closes the request's open span WITHOUT recording latency
+        samples — a quarantined or timed-out request must not poison the
+        TTFT/E2E histograms the SLO control loop reads."""
+        self.counters["faults"] += 1
+        self._inc_labeled("faults", (("kind", kind),))
+        if kind == "poison_row":
+            self.counters["quarantined"] += 1
+        elif kind == "deadline_expired":
+            self.counters["deadline_expired"] += 1
+        elif kind == "dispatch_retry":
+            self.counters["frame_retries"] += 1
+        elif kind == "slow_frame":
+            self.counters["slow_frames"] += 1
+        if uid is not None:
+            self._open_spans.pop(uid, None)
+
+    def on_recover(self, n_requests: int, recovery_ms: float) -> None:
+        """A ``serve(..., resume_from=)`` run re-admitted ``n_requests``
+        snapshot requests; ``recovery_ms`` is resume-start → last
+        re-admission (the window clients waited on the restarted engine)."""
+        self.counters["recoveries"] += n_requests
+        self.gauges["last_recovery_ms"] = round(recovery_ms, 3)
 
     def slo_view(self) -> Dict[str, Optional[float]]:
         """LIVE SLO signal: p90 (ms) over the recent sample windows — the

@@ -20,27 +20,51 @@ from ...utils.logging import logger
 
 
 class AsyncTensorSwapper:
-    """Swap individual arrays to files, asynchronously."""
+    """Swap individual arrays to files, asynchronously.
+
+    Writes are ATOMIC per key: ``swap_out`` streams into ``<key>.swp.tmp``
+    and only an error-free ``wait`` renames it over ``<key>.swp`` — an aio
+    error can therefore never leave a truncated ``.swp`` behind (a partial
+    file would deserialize into garbage optimizer state on the next
+    ``swap_in``, long after the error was swallowed). On failure the temp
+    file is removed, the key's previous metadata (and previous ``.swp``, if
+    one existed) is preserved, and the raised error names the keys whose
+    writes were in flight."""
 
     def __init__(self, swap_dir: str, aio_handle: Optional[AsyncIOHandle] = None):
         self.swap_dir = swap_dir
         os.makedirs(swap_dir, exist_ok=True)
         self.aio = aio_handle or AsyncIOHandle()
         self._meta: Dict[str, tuple] = {}
+        # key -> (tmp_path, previous meta or None): writes pending rename
+        self._pending: Dict[str, tuple] = {}
 
     def _path(self, key: str) -> str:
         return os.path.join(self.swap_dir, f"{key}.swp")
 
     def swap_out(self, key: str, arr, async_op: bool = False):
         host = np.ascontiguousarray(np.asarray(arr))
+        tmp = self._path(key) + ".tmp"
+        if key in self._pending:
+            # re-swap of a key whose previous write hasn't committed yet:
+            # the rollback target stays the last COMMITTED state, not the
+            # uncommitted first attempt
+            _tmp, prev = self._pending[key]
+        else:
+            prev = self._meta.get(key)
+        self._pending[key] = (tmp, prev)
         self._meta[key] = (host.shape, host.dtype)
-        self.aio.async_pwrite(host, self._path(key))
+        self.aio.async_pwrite(host, tmp)
         if not async_op:
-            errs = self.aio.wait()
-            if errs:
-                raise IOError(f"swap_out({key}): {errs} aio errors")
+            self.wait()
 
     def swap_in(self, key: str, async_op: bool = False):
+        if self._pending:
+            # the shared aio queue may hold un-finalized swap-out writes
+            # (data still in .swp.tmp, or errors that must roll them
+            # back) — draining it with a bare aio.wait() here would eat
+            # those errors and let a later wait() commit a truncated file
+            self.wait()
         shape, dtype = self._meta[key]
         buf = np.empty(shape, dtype)
         self.aio.async_pread(buf, self._path(key))
@@ -51,14 +75,41 @@ class AsyncTensorSwapper:
         return buf
 
     def wait(self):
-        return self.aio.wait()
+        """Drain the aio queue and finalize pending swap-outs: error-free
+        writes rename ``.swp.tmp`` → ``.swp`` atomically; on any error every
+        pending write is rolled back (temp removed, previous metadata — and
+        hence the previous ``.swp`` — restored) and the raise names the
+        affected keys."""
+        errs = self.aio.wait()
+        if not self._pending:
+            return errs
+        pending, self._pending = self._pending, {}
+        if errs:
+            for key, (tmp, prev_meta) in pending.items():
+                if prev_meta is None:
+                    self._meta.pop(key, None)
+                else:
+                    self._meta[key] = prev_meta
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            keys = ", ".join(sorted(pending))
+            raise IOError(
+                f"swap_out({keys}): {errs} aio errors (partial .swp.tmp "
+                "files removed; previous .swp contents intact)")
+        for key, (tmp, _prev) in pending.items():
+            os.replace(tmp, self._path(key))
+        return errs
 
     def release(self, key: str):
         self._meta.pop(key, None)
-        try:
-            os.remove(self._path(key))
-        except OSError:
-            pass
+        pend = self._pending.pop(key, None)
+        for path in ([pend[0]] if pend else []) + [self._path(key)]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
 
 
 class OptimizerSwapper:

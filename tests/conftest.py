@@ -32,6 +32,12 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: wall-clock-sensitive or long-running; excluded "
         "from the tier-1 CPU suite (-m 'not slow')")
+    # chaos tests are deterministic (scripted FaultInjector schedules, no
+    # randomness, no wall-clock assertions) and run IN tier-1: fault
+    # handling that is only exercised nightly is fault handling that rots
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection serving tests "
+        "(tests/test_serving_faults.py); included in tier-1")
 
 
 @pytest.fixture(autouse=True)

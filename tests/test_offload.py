@@ -85,6 +85,92 @@ def test_optimizer_swapper_roundtrip(tmp_path):
     assert int(back["step"]) == 3
 
 
+class _FailingAIO:
+    """aio stub that lands a truncated write, then reports errors from
+    wait() — the scenario that used to leave a partial .swp behind."""
+
+    def __init__(self, errs=1):
+        self.errs = errs
+
+    def async_pwrite(self, arr, path):
+        with open(path, "wb") as f:
+            f.write(b"partial")
+
+    def async_pread(self, arr, path):
+        raise AssertionError("no reads expected")
+
+    def wait(self):
+        return self.errs
+
+
+def test_swapper_failed_swap_out_cleans_up(tmp_path):
+    """An aio error during swap_out must not leave a partial .swp (or the
+    .swp.tmp staging file) behind, must drop the key's metadata, and must
+    name the key in the raised error."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path), aio_handle=_FailingAIO())
+    with pytest.raises(IOError, match="opt_3"):
+        sw.swap_out("opt_3", np.arange(8, dtype=np.float32))
+    assert list(tmp_path.iterdir()) == []      # nothing stranded on disk
+    assert "opt_3" not in sw._meta             # no stale metadata either
+    assert not sw._pending
+
+
+def test_swapper_failed_overwrite_preserves_previous(tmp_path):
+    """Atomicity: a failed RE-swap of an existing key leaves the previous
+    .swp contents AND metadata intact — swap_in still returns the last
+    successfully committed array, not garbage from a truncated write."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path))
+    first = np.arange(16, dtype=np.float32)
+    sw.swap_out("k", first)
+    assert (tmp_path / "k.swp").exists()
+    assert not (tmp_path / "k.swp.tmp").exists()   # tmp renamed away
+
+    sw.aio = _FailingAIO()
+    with pytest.raises(IOError, match="k"):
+        sw.swap_out("k", np.ones((4, 4), np.float64))
+    assert not (tmp_path / "k.swp.tmp").exists()   # staging file removed
+
+    sw.aio = AsyncIOHandle()
+    back = sw.swap_in("k")                         # previous commit intact
+    np.testing.assert_array_equal(back, first)
+
+
+def test_swapper_swap_in_finalizes_pending_writes(tmp_path):
+    """swap_in on a swapper with un-waited async writes must finalize them
+    through the atomic-commit/rollback path first — draining the shared
+    aio queue bare would eat the write errors, and a later wait() would
+    then happily rename the truncated tmp over the good .swp."""
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path))
+    good = np.arange(8, dtype=np.float32)
+    sw.swap_out("k", good)                            # committed
+    sw.aio = _FailingAIO()
+    sw.swap_out("k", np.ones(16, np.float32), async_op=True)
+    with pytest.raises(IOError, match="k"):
+        sw.swap_in("k")               # surfaces the in-flight write error
+    sw.aio = AsyncIOHandle()
+    assert sw.wait() == 0             # nothing left behind to mis-commit
+    np.testing.assert_array_equal(sw.swap_in("k"), good)
+
+
+def test_swapper_async_batch_failure_names_keys(tmp_path):
+    """The async path (OptimizerSwapper's batched swap_out) finalizes at
+    wait(): on error every pending write rolls back and the raise names
+    the in-flight keys."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path), aio_handle=_FailingAIO())
+    sw.swap_out("a", np.zeros(4, np.float32), async_op=True)
+    sw.swap_out("b", np.ones(4, np.float32), async_op=True)
+    with pytest.raises(IOError, match="a, b"):
+        sw.wait()
+    assert list(tmp_path.iterdir()) == []
+    assert not sw._meta and not sw._pending
+
+
 def test_engine_nvme_offload(tmp_path, mesh_8dp):
     """ZeRO-2 + NVMe optimizer offload trains and matches no-offload run."""
     def run(offload):
