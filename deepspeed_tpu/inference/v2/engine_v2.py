@@ -101,6 +101,27 @@ class RaggedInferenceEngineConfig:
     # actual recovery mechanism) can act at the next boundary.
     watchdog_frame_ms: Optional[float] = None
     fault_log_max: int = 256
+    # tensor-parallel serving (README "Multi-chip serving"): shard the model
+    # weights (Megatron column/row via parallel/sharding.py rules) and the
+    # paged KV pools (head-wise) across a 1-D tp mesh of the first `tp`
+    # local devices; the frame loops compile under shard_map with the whole
+    # slot-table carry REPLICATED, so admission, scheduling, deadlines,
+    # quarantine, and crash snapshots stay single-host and frame-boundary-
+    # only. tp=1 never touches shard_map — byte-identical to the unsharded
+    # engine (serving_bench.py --tp asserts this inline).
+    tp: int = 1
+    # int8-quantized all-reduce/all-gather for the per-step activation and
+    # logit exchanges (EQuARX, arXiv 2506.17615): opt-in, parity-at-
+    # tolerance (tests/test_serving_tp.py pins the contract)
+    tp_quantized_collectives: bool = False
+    # decompose the MLP all-reduce into ppermute ring chunks XLA can
+    # schedule around neighboring compute (T3, arXiv 2401.16677): opt-in;
+    # ring summation order differs from psum, so parity is at-tolerance
+    tp_overlap_collectives: bool = False
+    # debug mode: read the per-shard frame-counter rows at every boundary
+    # and assert they agree (replica-consistency proof); steady state reads
+    # shard 0 only
+    tp_debug_replica_check: bool = False
     dtype: str = "bfloat16"
 
 
@@ -156,10 +177,38 @@ class InferenceEngineV2:
         self._ledger: Dict[int, LedgerEntry] = {}
         self._resume_pending: set = set()
         self._clock = time.monotonic
+        # tensor-parallel serving context (tp.TPContext): set up BEFORE any
+        # draft attach so the draft shards onto the same mesh
+        self.tp_ctx = None
+        if c.tp > 1:
+            self._init_tensor_parallel()
         if draft_model is not None:
             self.attach_draft(draft_model, draft_params)
         log_dist(f"InferenceEngineV2: blocks={num_blocks}x{bs} "
                  f"budget={c.max_tokens_per_step} chunk={c.prefill_chunk_size}", ranks=[0])
+
+    def _init_tensor_parallel(self) -> None:
+        """Shard the engine across the 1-D tp mesh: validate the arch
+        (``archs.validate_tp_serving``), column/row-shard the weights per
+        the ``parallel/sharding.py`` logical-axis rules, shard the paged KV
+        pools head-wise, and bind the context to the runner so every
+        serving loop compiles under shard_map. Slot tables created by
+        ``serve()`` pick the context up per-run."""
+        from jax.sharding import NamedSharding
+        from .tp import build_tp_context
+        c = self._config
+        ctx = build_tp_context(self.model, c.tp,
+                               quantized=c.tp_quantized_collectives,
+                               overlap=c.tp_overlap_collectives)
+        self.tp_ctx = ctx
+        self.params = ctx.shard_params(self.params)
+        self.kv.shard(NamedSharding(ctx.mesh, ctx.kv_spec))
+        self.runner.set_tp(ctx)
+        log_dist(
+            f"InferenceEngineV2: tensor-parallel serving tp={c.tp} "
+            f"(vocab_sharded={ctx.vocab_sharded} "
+            f"quantized={c.tp_quantized_collectives} "
+            f"overlap={c.tp_overlap_collectives})", ranks=[0])
 
     def attach_draft(self, draft_model, draft_params=None) -> None:
         """Attach a small draft ``CausalLM`` for speculative decoding.
@@ -213,6 +262,20 @@ class InferenceEngineV2:
             dtype=dcfg.act_dtype)
         self.draft_runner = PagedModelRunner(self.draft_model, c.kv_block_size,
                                              self.max_blocks_per_seq)
+        if self.tp_ctx is not None:
+            # the draft rides the target's mesh: same divisibility contract
+            # (validated with role="draft" so the error names the culprit),
+            # its params sharded by its own logical axes, its paged KV
+            # pools head-wise like the target's
+            from jax.sharding import NamedSharding
+            from .tp import build_tp_context
+            dctx = build_tp_context(self.draft_model, c.tp,
+                                    quantized=c.tp_quantized_collectives,
+                                    overlap=c.tp_overlap_collectives,
+                                    role="draft", mesh=self.tp_ctx.mesh)
+            self.draft_params = dctx.shard_params(self.draft_params)
+            self.draft_kv.shard(NamedSharding(dctx.mesh, dctx.kv_spec))
+            self.draft_runner.set_tp(dctx)
         # the speculative loops close over the draft runner's _forward: a
         # re-attach must evict them or the old draft would keep running
         # (evict() folds their programs into the monotonic compile total)
@@ -692,7 +755,8 @@ class InferenceEngineV2:
             frame_rng = rng
         slots = DeviceSlotTable(
             n_slots, prompt_width=c.prefill_chunk_size,
-            table_width=1, rng=frame_rng)
+            table_width=1, rng=frame_rng, tp=self.tp_ctx,
+            debug_replicas=c.tp_debug_replica_check)
         if faults is not None:
             faults.begin_serve()     # rearm the scripted schedule
         resume = self._resume_entries(resume_from)
@@ -700,7 +764,8 @@ class InferenceEngineV2:
         self._resume_pending = {r[0] for r in resume}
         self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
                                    adaptive=adaptive, n_slots=n_slots,
-                                   kv_blocks_total=self.kv.num_blocks)
+                                   kv_blocks_total=self.kv.num_blocks,
+                                   tp_degree=self._config.tp)
         if scheduler is not None:
             scheduler.begin_serve(self)
             return self._serve_guarded_sched(

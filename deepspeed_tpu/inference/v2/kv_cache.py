@@ -35,6 +35,16 @@ class BlockedKVCache:
     def blocks_for(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
 
+    def shard(self, sharding) -> None:
+        """Re-place the pools under an explicit sharding (tensor-parallel
+        serving: ``P(None, tp)`` — head-wise, axis 1 of
+        (L, KVH, NB, bs, D)). The block layout, allocator, and block tables
+        are untouched: a KV page is (layer, head, block) addressed, so
+        splitting the head dim leaves every page id meaning the same thing
+        on every shard — admission control stays topology-blind."""
+        self.k = jax.device_put(self.k, sharding)
+        self.v = jax.device_put(self.v, sharding)
+
     def reserve_trash_block(self) -> None:
         """Pin block 0 as the trash block: padded/frozen rows' writes (and
         pad-position reads) are routed there, so it must never be handed to

@@ -1204,3 +1204,40 @@ def build_native(hf_model, dtype: str = None) -> Tuple[CausalLM, Dict]:
     sd = hf_model.state_dict()
     params = container.build_params(sd, cfg)
     return container.model_class(cfg), params
+
+
+def validate_tp_serving(cfg: TransformerConfig, tp: int,
+                        role: str = "target") -> None:
+    """Fail LOUDLY if this architecture cannot run tensor-parallel serving
+    at degree ``tp`` (the shard_map frame loops, ``model_runner.py``).
+
+    The manual TP layout shards attention heads, KV heads (and their paged
+    KV pools), and the MLP intermediate dim; every one of those must divide
+    by ``tp`` — a silent per-tensor replication fallback would break the
+    per-layer psum arithmetic, so unlike training FSDP this is all-or-
+    nothing. Vocab is the one axis allowed to fall back (replicated embed +
+    LM head when ``vocab_size % tp != 0``): that costs memory, not
+    correctness. Checked at engine construction AND draft attach — the
+    draft rides the same mesh, so it must satisfy the same divisibility
+    (``role`` names the offender in the error)."""
+    if tp <= 1:
+        return
+    probs = []
+    if cfg.is_moe:
+        probs.append("MoE layers (expert parallelism is a different axis; "
+                     "serve MoE models single-chip or add expert sharding)")
+    if cfg.num_heads % tp:
+        probs.append(f"num_heads={cfg.num_heads} not divisible by tp={tp}")
+    if cfg.kv_heads % tp:
+        probs.append(f"kv_heads={cfg.kv_heads} not divisible by tp={tp} "
+                     "(the paged KV pools shard head-wise)")
+    if cfg.ffn_size % tp:
+        probs.append(f"ffn_size={cfg.ffn_size} not divisible by tp={tp}")
+    if cfg.qk_norm in ("full", "per_head"):
+        probs.append(f"qk_norm={cfg.qk_norm!r} norm weights span the head "
+                     "dim that TP shards (use 'head_dim'-shared QK norms, "
+                     "or serve single-chip)")
+    if probs:
+        raise NotImplementedError(
+            f"tensor-parallel serving (tp={tp}) unsupported for the {role} "
+            "model: " + "; ".join(probs))

@@ -22,6 +22,8 @@ import os
 
 import jax
 import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
 
 from ...models import layers as L
 from ...models.transformer import CausalLM
@@ -53,6 +55,19 @@ class PagedModelRunner:
         # total honest when _fns entries disappear
         self._evicted_programs = 0
         self._compile_base = 0
+        # tensor-parallel serving context (tp.TPContext) — when set, the
+        # serving loops compile under shard_map on its 1-D tp mesh and the
+        # forward issues explicit per-layer collectives; None keeps every
+        # path byte-identical to the unsharded runner
+        self.tp = None
+
+    def set_tp(self, tp_ctx) -> None:
+        """Bind a ``tp.TPContext`` (engine setup, before any serving loop
+        compiles). The serving entry points close over the context, so any
+        already-compiled loops must go — same discipline as a draft
+        re-attach."""
+        self.evict(*list(self._fns))
+        self.tp = tp_ctx
 
     def _build(self, chunk: int):
         fwd = self._forward
@@ -64,19 +79,39 @@ class PagedModelRunner:
         return run
 
     def _forward(self, params, ids, positions, block_tables, valid_counts,
-                 kpool, vpool, *, all_logits=False):
+                 kpool, vpool, *, all_logits=False, tp=None):
         """ids/positions: (B, C); block_tables: (B, MB);
         valid_counts: (B,) number of real (non-pad) tokens in the chunk;
         kpool/vpool: (L, KVH, NB, bs, D). Returns (last_logits (B, V),
         kpool, vpool) — or ((B, C, V) logits at EVERY chunk position when
         ``all_logits`` is set, which is how the speculative verify scores
-        all gamma+1 positions in one batched ragged forward."""
+        all gamma+1 positions in one batched ragged forward.
+
+        ``tp`` (a ``tp.TPContext``) marks a trace INSIDE a shard_map manual
+        region: params and KV pools are this shard's slices (heads/kv_heads/
+        mlp/vocab-sharded per ``parallel/sharding.py``), and the forward
+        issues the explicit Megatron collectives — masked-lookup psum for
+        the vocab-sharded embedding, a psum after the attention-output and
+        MLP-output (row-parallel) projections, and a logit all-gather at the
+        head. ``tp=None`` traces the exact pre-TP program."""
         cfg = self.cfg
         bs = self.block_size
         model = self.model
         dt = cfg.act_dtype
         b, c = ids.shape
-        h = params["embed"]["tok"].astype(dt)[ids]
+        if tp is not None and tp.vocab_sharded:
+            # Megatron vocab-parallel lookup: each shard holds rows
+            # [r*V/tp, (r+1)*V/tp) — mask out-of-range ids, psum selects the
+            # one shard holding each token's row
+            tok = params["embed"]["tok"].astype(dt)
+            vs = tok.shape[0]
+            off = jax.lax.axis_index(tp.axis) * vs
+            lid = jnp.clip(ids - off, 0, vs - 1)
+            h = jnp.where(((ids >= off) & (ids < off + vs))[..., None],
+                          tok[lid], jnp.zeros((), dt))
+            h = tp.coll.psum_embed(h)
+        else:
+            h = params["embed"]["tok"].astype(dt)[ids]
         if cfg.embed_scale != 1.0:
             h = h * jnp.asarray(cfg.embed_scale, dt)
         if cfg.position == "learned":
@@ -103,6 +138,15 @@ class PagedModelRunner:
         if cfg.sliding_window is not None and cfg.local_attention_every is None \
                 and cfg.sliding_window < block_tables.shape[1] * bs:
             uniform_window = cfg.sliding_window   # binds within this pool
+
+        slopes = None
+        if cfg.position == "alibi":
+            slopes = L.alibi_slopes(cfg.num_heads)
+            if tp is not None:
+                # each shard owns a contiguous head slice — its slopes too
+                h_loc = cfg.num_heads // tp.degree
+                slopes = jax.lax.dynamic_slice_in_dim(
+                    slopes, jax.lax.axis_index(tp.axis) * h_loc, h_loc)
 
         def layer(h, xs, tag=None):
             lp, l, win = xs
@@ -142,25 +186,30 @@ class PagedModelRunner:
                 # run in-kernel (the FastGen blocked-flash surface); the
                 # kernel indexes (layer, head, page) in the full pool
                 from ...ops.pallas.paged_attention import paged_ragged_attention
-                slopes = (L.alibi_slopes(cfg.num_heads)
-                          if cfg.position == "alibi" else None)
                 out = paged_ragged_attention(
                     q, kpool, vpool, block_tables, positions, k, v, layer=l,
                     scale=cfg.attn_scale, window=win, alibi_slopes=slopes,
                     softcap=cfg.attn_softcap)
             else:
+                kvh_loc = kpool.shape[1]   # local KV heads (KVH/tp under tp)
                 kl = jnp.take(kpool, l, axis=0)   # escape hatch: copies 1/L
                 vl = jnp.take(vpool, l, axis=0)
                 kpages = kl[:, block_tables].reshape(
-                    cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
+                    kvh_loc, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
                 vpages = vl[:, block_tables].reshape(
-                    cfg.kv_heads, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
+                    kvh_loc, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
                 # per-query causal mask via positions: query at position p
                 # sees cache slots [0, p]; masks by slot index.
                 out = _paged_attention(q, kpages, vpages, positions, cfg,
                                        window=win, chunk_k=k, chunk_v=v,
-                                       chunk_start=chunk_start)
+                                       chunk_start=chunk_start,
+                                       alibi_slopes=slopes)
+            # row-parallel output projection: under tp the per-shard product
+            # covers only the local heads — all-reduce BEFORE the replicated
+            # bias, so the bias is added exactly once
             y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
+            if tp is not None:
+                y = tp.coll.psum_attn(y)
             if "bo" in lp["attn"]:   # presence-keyed: out_bias may differ from use_bias
                 y = y + lp["attn"]["bo"].astype(dt)
             if cfg.sandwich_norm:   # Gemma-2 post-attn output norm
@@ -173,7 +222,9 @@ class PagedModelRunner:
             if cfg.is_moe if tag is None else tag == "moe":   # group tag overrides
                 mlp_out, _ = L.apply_moe_mlp(lp["mlp"], m_in, cfg)
             else:
-                mlp_out = L.apply_mlp(lp["mlp"], m_in, cfg)
+                mlp_out = L.apply_mlp(
+                    lp["mlp"], m_in, cfg,
+                    reduce=tp.coll.psum_mlp if tp is not None else None)
             if cfg.sandwich_norm:
                 mlp_out = L.apply_norm(lp["norm4"], mlp_out, cfg)
             h = h + y + mlp_out if cfg.parallel_block else h + mlp_out
@@ -182,7 +233,8 @@ class PagedModelRunner:
         h, kpool, vpool = self._run_layers(layer, h, params, kpool, vpool,
                                            windows, blk, off)
         h = L.apply_norm(params["final_norm"], h, cfg)
-        return self._head(params, h, valid_counts, all_logits), kpool, vpool
+        return (self._head(params, h, valid_counts, all_logits, tp=tp),
+                kpool, vpool)
 
     def _run_layers(self, layer, h, params, kpool, vpool, windows, blk, off):
         """Drive ``layer`` over the stack following the model's layer plan
@@ -211,10 +263,17 @@ class PagedModelRunner:
         vpool = vpool.at[:, :, blk, off].set(cv_all.transpose(0, 3, 1, 2, 4))
         return h, kpool, vpool
 
-    def _head(self, params, h, valid_counts, all_logits=False):
+    def _head(self, params, h, valid_counts, all_logits=False, tp=None):
         """Last-valid-token logits (B, V) from normed hidden states — or
         per-position logits (B, C, V) when ``all_logits`` (the speculative
-        verify needs the target's distribution at every drafted slot)."""
+        verify needs the target's distribution at every drafted slot).
+
+        Under a vocab-sharded ``tp`` the local product is this shard's
+        (…, V/tp) logit columns; bias and softcap are elementwise, so they
+        apply shard-local, and ONE all-gather (the per-step logit exchange —
+        int8-quantizable, see ``parallel/collectives.py``) assembles the
+        full vocab every consumer downstream (argmax, sampling, speculative
+        verify) sees replicated."""
         cfg = self.cfg
         dt = cfg.act_dtype
         if all_logits:
@@ -233,6 +292,8 @@ class PagedModelRunner:
             logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
         if cfg.logit_softcap:
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
+        if tp is not None and tp.vocab_sharded:
+            logits = tp.coll.gather_logits(logits)
         return logits.astype(jnp.float32)
 
     def _build_decode_loop(self):
@@ -277,8 +338,23 @@ class PagedModelRunner:
             self._fns["loop"] = self._build_decode_loop()
         return self._fns["loop"](*args, **kwargs)
 
+    def _tp_call(self, core, args, carry_specs, out_specs):
+        """Run ``core`` under shard_map on the tp mesh (``self.tp``):
+        ``carry_specs``/``out_specs`` are flat tuples of PartitionSpecs for
+        the array args after the param tree(s); param trees shard per the
+        context's spec tree. check_rep is off — replication of the
+        unmapped outputs is by construction (every carry input is
+        replicated and every shard-varying intermediate passes through a
+        psum/all-gather before reaching them), and the stats lanes carry a
+        per-shard copy precisely so ``DeviceSlotTable.stats_delta`` can
+        ASSERT that construction in debug mode instead of trusting it."""
+        tp = self.tp
+        return shard_map(core, mesh=tp.mesh, in_specs=carry_specs,
+                         out_specs=out_specs, check_rep=False)(*args)
+
     def _build_mixed_loop(self):
-        fwd = self._forward
+        tp = self.tp
+        fwd = functools.partial(self._forward, tp=tp)
 
         @functools.partial(jax.jit, donate_argnums=(4, 5),
                            static_argnames=("chunk", "wide_steps",
@@ -303,29 +379,43 @@ class PagedModelRunner:
             (wide_steps + narrow_steps, B), an emit mask of the same shape,
             and the updated pools.
             """
-            b = prompts.shape[0]
-            # no EOS in this loop (host truncates after); sampled ids are
-            # never negative, so -1 can't match. Uniform per-row temps make
-            # the scalar-temperature sampling bit-identical to before.
-            no_eos = jnp.full((b,), -1, jnp.int32)
-            temps = jnp.full((b,), temperature, jnp.float32)
+            def core(params, prompts, prompt_lens, new_limits, kpool, vpool,
+                     block_tables, rng, temperature):
+                b = prompts.shape[0]
+                # no EOS in this loop (host truncates after); sampled ids
+                # are never negative, so -1 can't match. Uniform per-row
+                # temps make the scalar-temperature sampling bit-identical
+                # to before.
+                no_eos = jnp.full((b,), -1, jnp.int32)
+                temps = jnp.full((b,), temperature, jnp.float32)
 
-            def make_body(width):
-                return _serving_scan_body(fwd, params, prompts, prompt_lens,
-                                          new_limits, no_eos, temps,
-                                          block_tables, width, greedy)
+                def make_body(width):
+                    return _serving_scan_body(fwd, params, prompts,
+                                              prompt_lens, new_limits,
+                                              no_eos, temps, block_tables,
+                                              width, greedy)
 
-            zero = jnp.zeros((b,), jnp.int32)
-            no = jnp.zeros((b,), bool)
-            carry = (zero, zero, zero, no, no, no,
-                     jnp.zeros((N_STATS,), jnp.int32), rng, kpool, vpool)
-            carry, (toks_w, emit_w) = jax.lax.scan(
-                make_body(chunk), carry, None, length=wide_steps)
-            carry, (toks_n, emit_n) = jax.lax.scan(
-                make_body(1), carry, None, length=narrow_steps)
-            kpool, vpool = carry[8], carry[9]
-            return (jnp.concatenate([toks_w, toks_n]),
-                    jnp.concatenate([emit_w, emit_n]), kpool, vpool)
+                zero = jnp.zeros((b,), jnp.int32)
+                no = jnp.zeros((b,), bool)
+                carry = (zero, zero, zero, no, no, no,
+                         jnp.zeros((N_STATS,), jnp.int32), rng, kpool, vpool)
+                carry, (toks_w, emit_w) = jax.lax.scan(
+                    make_body(chunk), carry, None, length=wide_steps)
+                carry, (toks_n, emit_n) = jax.lax.scan(
+                    make_body(1), carry, None, length=narrow_steps)
+                kpool, vpool = carry[8], carry[9]
+                return (jnp.concatenate([toks_w, toks_n]),
+                        jnp.concatenate([emit_w, emit_n]), kpool, vpool)
+
+            args = (params, prompts, prompt_lens, new_limits, kpool, vpool,
+                    block_tables, rng, temperature)
+            if tp is None:
+                return core(*args)
+            rep, kv = P(), tp.kv_spec
+            return self._tp_call(
+                core, args,
+                (tp.param_specs, rep, rep, rep, kv, kv, rep, rep, rep),
+                (rep, rep, kv, kv))
 
         return loop
 
@@ -335,7 +425,8 @@ class PagedModelRunner:
         return self._fns["mixed"](*args, **kwargs)
 
     def _build_frame_loop(self):
-        fwd = self._forward
+        tp = self.tp
+        fwd = functools.partial(self._forward, tp=tp)
 
         @functools.partial(jax.jit,
                            donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15,
@@ -367,14 +458,39 @@ class PagedModelRunner:
             (B,) bools are the fault-injection flag and the per-row
             finite-check latch (``faults.py``): both ride the donated
             carry, so arming a fault or detecting a NaN never retraces.
+
+            Tensor-parallel (``self.tp`` set): the same program compiles
+            under shard_map on the 1-D tp mesh — params and KV pools
+            sharded, every slot-state carry replicated, and ``stats``
+            per-shard as (tp, N_STATS) (each shard accumulates its own
+            replica-consistent row; the boundary reads shard 0).
             """
-            body = _serving_scan_body(fwd, params, prompts, prompt_lens,
-                                      limits, eos_ids, temps, tables, width,
-                                      greedy)
-            carry = (cached, produced, last_tok, done, poison, nonfinite,
-                     stats, rng, kpool, vpool)
-            carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
-            return (toks, emit) + carry
+            def core(params, prompts, prompt_lens, limits, eos_ids, temps,
+                     tables, cached, produced, last_tok, done, poison,
+                     nonfinite, stats, rng, kpool, vpool):
+                if tp is not None:
+                    stats = stats[0]        # this shard's (N_STATS,) row
+                body = _serving_scan_body(fwd, params, prompts, prompt_lens,
+                                          limits, eos_ids, temps, tables,
+                                          width, greedy)
+                carry = (cached, produced, last_tok, done, poison, nonfinite,
+                         stats, rng, kpool, vpool)
+                carry, (toks, emit) = jax.lax.scan(body, carry, None,
+                                                   length=steps)
+                if tp is not None:
+                    carry = carry[:6] + (carry[6][None],) + carry[7:]
+                return (toks, emit) + carry
+
+            args = (params, prompts, prompt_lens, limits, eos_ids, temps,
+                    tables, cached, produced, last_tok, done, poison,
+                    nonfinite, stats, rng, kpool, vpool)
+            if tp is None:
+                return core(*args)
+            rep, kv, st = P(), tp.kv_spec, tp.stats_spec
+            return self._tp_call(
+                core, args,
+                (tp.param_specs,) + (rep,) * 12 + (st, rep, kv, kv),
+                (rep,) * 8 + (st, rep, kv, kv))
 
         return loop
 
@@ -384,8 +500,10 @@ class PagedModelRunner:
         return self._fns["frame"](*args, **kwargs)
 
     def _build_frame_loop_spec(self, draft_runner):
-        fwd = self._forward
-        draft_fwd = draft_runner._forward
+        tp = self.tp
+        fwd = functools.partial(self._forward, tp=tp)
+        draft_fwd = functools.partial(draft_runner._forward,
+                                      tp=draft_runner.tp)
 
         @functools.partial(jax.jit,
                            donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16,
@@ -409,14 +527,36 @@ class PagedModelRunner:
             per row; the first draft step of each speculative step re-feeds
             it so the draft cache self-heals after a fully-accepted step
             without a separate catch-up forward."""
-            body = _serving_scan_body(fwd, params, prompts, prompt_lens,
-                                      limits, eos_ids, temps, tables, width,
-                                      greedy,
-                                      draft=(draft_fwd, draft_params, gamma))
-            carry = (cached, produced, last_tok, penult, done, poison,
-                     nonfinite, stats, rng, kpool, vpool, dkpool, dvpool)
-            carry, (toks, emit) = jax.lax.scan(body, carry, None, length=steps)
-            return (toks, emit) + carry
+            def core(params, draft_params, prompts, prompt_lens, limits,
+                     eos_ids, temps, tables, cached, produced, last_tok,
+                     penult, done, poison, nonfinite, stats, rng, kpool,
+                     vpool, dkpool, dvpool):
+                if tp is not None:
+                    stats = stats[0]
+                body = _serving_scan_body(
+                    fwd, params, prompts, prompt_lens, limits, eos_ids,
+                    temps, tables, width, greedy,
+                    draft=(draft_fwd, draft_params, gamma))
+                carry = (cached, produced, last_tok, penult, done, poison,
+                         nonfinite, stats, rng, kpool, vpool, dkpool, dvpool)
+                carry, (toks, emit) = jax.lax.scan(body, carry, None,
+                                                   length=steps)
+                if tp is not None:
+                    carry = carry[:7] + (carry[7][None],) + carry[8:]
+                return (toks, emit) + carry
+
+            args = (params, draft_params, prompts, prompt_lens, limits,
+                    eos_ids, temps, tables, cached, produced, last_tok,
+                    penult, done, poison, nonfinite, stats, rng, kpool,
+                    vpool, dkpool, dvpool)
+            if tp is None:
+                return core(*args)
+            rep, kv, st = P(), tp.kv_spec, tp.stats_spec
+            return self._tp_call(
+                core, args,
+                (tp.param_specs, draft_runner.tp.param_specs)
+                + (rep,) * 13 + (st, rep, kv, kv, kv, kv),
+                (rep,) * 9 + (st, rep, kv, kv, kv, kv))
 
         return loop
 
@@ -426,8 +566,10 @@ class PagedModelRunner:
         return self._fns["spec_frame"](*args, **kwargs)
 
     def _build_mixed_loop_spec(self, draft_runner):
-        fwd = self._forward
-        draft_fwd = draft_runner._forward
+        tp = self.tp
+        fwd = functools.partial(self._forward, tp=tp)
+        draft_fwd = functools.partial(draft_runner._forward,
+                                      tp=draft_runner.tp)
 
         @functools.partial(jax.jit, donate_argnums=(5, 6, 7, 8),
                            static_argnames=("chunk", "wide_steps",
@@ -440,29 +582,45 @@ class PagedModelRunner:
             rows freeze at their limits, so ``narrow_steps`` stays the
             worst-case (no-acceptance) budget and early finishers coast.
             Returns tokens/emit shaped (steps, B, gamma+1)."""
-            b = prompts.shape[0]
-            no_eos = jnp.full((b,), -1, jnp.int32)
-            temps = jnp.full((b,), temperature, jnp.float32)
+            def core(params, draft_params, prompts, prompt_lens, new_limits,
+                     kpool, vpool, dkpool, dvpool, block_tables, rng,
+                     temperature):
+                b = prompts.shape[0]
+                no_eos = jnp.full((b,), -1, jnp.int32)
+                temps = jnp.full((b,), temperature, jnp.float32)
 
-            def make_body(width):
-                return _serving_scan_body(fwd, params, prompts, prompt_lens,
-                                          new_limits, no_eos, temps,
-                                          block_tables, width, greedy,
-                                          draft=(draft_fwd, draft_params,
-                                                 gamma))
+                def make_body(width):
+                    return _serving_scan_body(fwd, params, prompts,
+                                              prompt_lens, new_limits,
+                                              no_eos, temps, block_tables,
+                                              width, greedy,
+                                              draft=(draft_fwd, draft_params,
+                                                     gamma))
 
-            zero = jnp.zeros((b,), jnp.int32)
-            no = jnp.zeros((b,), bool)
-            carry = (zero, zero, zero, zero, no, no, no,
-                     jnp.zeros((N_STATS,), jnp.int32), rng,
-                     kpool, vpool, dkpool, dvpool)
-            carry, (toks_w, emit_w) = jax.lax.scan(
-                make_body(chunk), carry, None, length=wide_steps)
-            carry, (toks_n, emit_n) = jax.lax.scan(
-                make_body(1), carry, None, length=narrow_steps)
-            return (jnp.concatenate([toks_w, toks_n]),
-                    jnp.concatenate([emit_w, emit_n]),
-                    carry[9], carry[10], carry[11], carry[12])
+                zero = jnp.zeros((b,), jnp.int32)
+                no = jnp.zeros((b,), bool)
+                carry = (zero, zero, zero, zero, no, no, no,
+                         jnp.zeros((N_STATS,), jnp.int32), rng,
+                         kpool, vpool, dkpool, dvpool)
+                carry, (toks_w, emit_w) = jax.lax.scan(
+                    make_body(chunk), carry, None, length=wide_steps)
+                carry, (toks_n, emit_n) = jax.lax.scan(
+                    make_body(1), carry, None, length=narrow_steps)
+                return (jnp.concatenate([toks_w, toks_n]),
+                        jnp.concatenate([emit_w, emit_n]),
+                        carry[9], carry[10], carry[11], carry[12])
+
+            args = (params, draft_params, prompts, prompt_lens, new_limits,
+                    kpool, vpool, dkpool, dvpool, block_tables, rng,
+                    temperature)
+            if tp is None:
+                return core(*args)
+            rep, kv = P(), tp.kv_spec
+            return self._tp_call(
+                core, args,
+                (tp.param_specs, draft_runner.tp.param_specs, rep, rep, rep,
+                 kv, kv, kv, kv, rep, rep, rep),
+                (rep, rep, kv, kv, kv, kv))
 
         return loop
 
@@ -827,13 +985,16 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
 
 
 def _paged_attention(q, kpages, vpages, positions, cfg, window=None,
-                     chunk_k=None, chunk_v=None, chunk_start=None):
+                     chunk_k=None, chunk_v=None, chunk_start=None,
+                     alibi_slopes=None):
     """q: (B, C, H, D); kpages/vpages: (B, S_pad, KVH, D); positions: (B, C)
     absolute slot of each query (−1 = pad). Query at slot p attends slots ≤ p.
     ``window``: sliding-window width (may be traced; <= 0 = global).
     ``chunk_k/chunk_v``: (B, C, KVH, D) the current chunk's own KV — the
     pool slots >= ``chunk_start`` (B,) are stale and masked; the chunk keys
-    attend at key positions = ``positions``."""
+    attend at key positions = ``positions``. ``alibi_slopes``: per-head
+    slopes matching q's head count — the caller slices them under tensor
+    parallelism, where q carries only this shard's heads."""
     h = q.shape[2]
     s_pad = kpages.shape[1]
     k_pos = jnp.arange(s_pad)[None, :] * jnp.ones(
@@ -853,9 +1014,9 @@ def _paged_attention(q, kpages, vpages, positions, cfg, window=None,
     scale = cfg.attn_scale if cfg.attn_scale is not None else d ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, kpages,
                         preferred_element_type=jnp.float32) * scale
-    if cfg.position == "alibi":
+    if alibi_slopes is not None:
         # key position (gathered slot / chunk position) relative to query
-        logits = logits + (L.alibi_slopes(cfg.num_heads)[None, :, None, None]
+        logits = logits + (alibi_slopes[None, :, None, None]
                            * (k_pos[:, None, None, :].astype(jnp.float32)
                               - jnp.maximum(positions, 0)[:, None, :, None]))
     # softcap AFTER the bias — the order the Pallas kernel and

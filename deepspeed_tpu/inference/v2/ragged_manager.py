@@ -11,6 +11,7 @@ admission control and never reads slot state back mid-frame.
 import dataclasses
 from typing import Dict, List, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -114,32 +115,46 @@ class DeviceSlotTable:
     own KV pools on the committed prefix without a catch-up pass.
     """
 
-    def __init__(self, n_slots: int, prompt_width: int, table_width: int, rng):
+    def __init__(self, n_slots: int, prompt_width: int, table_width: int, rng,
+                 tp=None, debug_replicas: bool = False):
         self.n_slots = n_slots
-        zi = lambda *shape: jnp.zeros(shape, jnp.int32)  # noqa: E731
+        # tensor-parallel serving (tp.TPContext): every slot array is
+        # REPLICATED over the tp mesh — the frame loop's shard_map treats
+        # them as unmapped carries, and every frame-boundary mutation
+        # (admit/evict/set_poison) goes through ``_dev``, which places the
+        # update replicated so it lands as ONE logical mesh-wide write
+        # (XLA SPMD broadcasts it), never a per-shard host loop.
+        self.tp = tp
+        self.debug_replicas = debug_replicas
+        if tp is not None:
+            self._rep = tp.rep()
+            self._stats_sharding = jax.sharding.NamedSharding(
+                tp.mesh, tp.stats_spec)
+        zi = lambda *shape: self._dev(jnp.zeros(shape, jnp.int32))  # noqa: E731
         # device state (frame-loop inputs; carry arrays are donated)
         self.prompts = zi(n_slots, max(1, prompt_width))
         self.prompt_lens = zi(n_slots)
         self.limits = zi(n_slots)
-        self.eos_ids = jnp.full((n_slots,), -1, jnp.int32)
-        self.temps = jnp.zeros((n_slots,), jnp.float32)
+        self.eos_ids = self._dev(jnp.full((n_slots,), -1, jnp.int32))
+        self.temps = self._dev(jnp.zeros((n_slots,), jnp.float32))
         self.tables = zi(n_slots, max(1, table_width))
         self.cached = zi(n_slots)
         self.produced = zi(n_slots)
         self.last_tok = zi(n_slots)
         self.penult = zi(n_slots)          # speculative carry: token at cached-1
-        self.done = jnp.ones((n_slots,), bool)
+        self.done = self._dev(jnp.ones((n_slots,), bool))
         # fault-injection flag (frame NaNs the row's logits while set) and
         # the in-graph finite-check latch — both ride the donated carry
         # like stats, so arming a fault or catching a NaN never retraces
-        self.poison = jnp.zeros((n_slots,), bool)
-        self.nonfinite = jnp.zeros((n_slots,), bool)
-        self.rng = rng
+        self.poison = self._dev(jnp.zeros((n_slots,), bool))
+        self.nonfinite = self._dev(jnp.zeros((n_slots,), bool))
+        self.rng = self._dev(rng)
         # in-graph telemetry counters (telemetry.N_STATS): accumulate on the
         # donated carry; the host reads AND rebases them only at frame
         # boundaries (stats_delta), so the int32 lanes can never wrap
-        # within one read window
-        self.stats = zero_stats()
+        # within one read window. Under tp the vector is PER-SHARD,
+        # (tp, N_STATS) laid out one row per shard (tp.stats_spec).
+        self.stats = self._fresh_stats()
         # host mirrors — admission control only
         self.uid_of_slot = np.full((n_slots,), -1, np.int64)
         self.slot_of_uid: Dict[int, int] = {}
@@ -150,6 +165,21 @@ class DeviceSlotTable:
         self.eos_h = np.full((n_slots,), -1, np.int64)
         self.temps_h = np.zeros((n_slots,), np.float64)
         self.done_h = np.ones((n_slots,), bool)
+
+    def _dev(self, x):
+        """Stage a (small) host value onto the device — replicated over the
+        tp mesh when tensor-parallel, plain ``jnp.asarray`` otherwise. Every
+        frame-boundary H2D write funnels through here so sharded and
+        single-chip engines have the same one-write-per-mutation shape."""
+        if self.tp is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._rep)
+
+    def _fresh_stats(self):
+        if self.tp is None:
+            return zero_stats()
+        return jax.device_put(zero_stats(self.tp.degree),
+                              self._stats_sharding)
 
     @property
     def committed_h(self) -> np.ndarray:
@@ -188,11 +218,13 @@ class DeviceSlotTable:
         p = self.prompts.shape[1]
         if prompt_need > p:
             new_p = BlockedKVCache.bucket_width(prompt_need, prompt_cap)
-            self.prompts = jnp.pad(self.prompts, ((0, 0), (0, new_p - p)))
+            self.prompts = self._dev(
+                jnp.pad(self.prompts, ((0, 0), (0, new_p - p))))
         t = self.tables.shape[1]
         if table_need > t:
             new_t = BlockedKVCache.bucket_width(table_need, table_cap)
-            self.tables = jnp.pad(self.tables, ((0, 0), (0, new_t - t)))
+            self.tables = self._dev(
+                jnp.pad(self.tables, ((0, 0), (0, new_t - t))))
 
     def admit(self, items: List[Tuple]) -> None:
         """Admit arrivals into free slots: ``items`` is a list of
@@ -228,15 +260,23 @@ class DeviceSlotTable:
             lims.append(limit)
             eoss.append(-1 if eos is None else eos)
             temps.append(temp)
-        idx = jnp.asarray(rows, jnp.int32)
-        self.prompts = self.prompts.at[idx].set(jnp.asarray(np.stack(p_rows)))
-        self.tables = self.tables.at[idx].set(jnp.asarray(np.stack(t_rows)))
+        # _dev places every staged operand replicated under tp, so each
+        # scatter below is one logical mesh-wide update (XLA keeps the
+        # result replicated), not a per-shard host loop
+        idx = self._dev(jnp.asarray(rows, jnp.int32))
+        self.prompts = self.prompts.at[idx].set(
+            self._dev(jnp.asarray(np.stack(p_rows))))
+        self.tables = self.tables.at[idx].set(
+            self._dev(jnp.asarray(np.stack(t_rows))))
         self.prompt_lens = self.prompt_lens.at[idx].set(
-            jnp.asarray(plens, jnp.int32))
-        self.limits = self.limits.at[idx].set(jnp.asarray(lims, jnp.int32))
-        self.eos_ids = self.eos_ids.at[idx].set(jnp.asarray(eoss, jnp.int32))
-        self.temps = self.temps.at[idx].set(jnp.asarray(temps, jnp.float32))
-        zero = jnp.zeros((len(rows),), jnp.int32)
+            self._dev(jnp.asarray(plens, jnp.int32)))
+        self.limits = self.limits.at[idx].set(
+            self._dev(jnp.asarray(lims, jnp.int32)))
+        self.eos_ids = self.eos_ids.at[idx].set(
+            self._dev(jnp.asarray(eoss, jnp.int32)))
+        self.temps = self.temps.at[idx].set(
+            self._dev(jnp.asarray(temps, jnp.float32)))
+        zero = self._dev(jnp.zeros((len(rows),), jnp.int32))
         self.cached = self.cached.at[idx].set(zero)
         self.produced = self.produced.at[idx].set(zero)
         self.last_tok = self.last_tok.at[idx].set(zero)
@@ -264,11 +304,14 @@ class DeviceSlotTable:
         width 0 and ``admit`` can rewrite it for a new request. One tiny
         host→device write at the boundary; nothing is read back (the host
         mirrors already hold the committed watermark and emitted tokens,
-        so the caller re-queues prompt + emitted for re-prefill)."""
+        so the caller re-queues prompt + emitted for re-prefill). Under
+        tensor parallelism the carry is replicated, so this stays ONE
+        logical write — ``_dev`` places the index replicated and XLA SPMD
+        applies the update mesh-wide, never a per-shard loop."""
         slot = self.slot_of_uid.pop(uid)
         self.uid_of_slot[slot] = -1
         self.done_h[slot] = True
-        idx = jnp.asarray([slot], jnp.int32)
+        idx = self._dev(jnp.asarray([slot], jnp.int32))
         self.done = self.done.at[idx].set(True)
         self.limits = self.limits.at[idx].set(0)
         # quarantine evicts through here too: clear the fault flags so the
@@ -329,7 +372,7 @@ class DeviceSlotTable:
         rows = [self.slot_of_uid[u] for u in uids if u in self.slot_of_uid]
         if not rows:
             return
-        idx = jnp.asarray(rows, jnp.int32)
+        idx = self._dev(jnp.asarray(rows, jnp.int32))
         self.poison = self.poison.at[idx].set(True)
 
     def nonfinite_uids(self) -> List[int]:
@@ -349,9 +392,32 @@ class DeviceSlotTable:
         overflow. The caller owns the read cadence: the engine reads every
         frame while telemetry is enabled, and after a disabled stretch it
         discards the first (backlog, possibly wrapped) delta. Both the
-        read and the fresh zero vector are frame-boundary transfers."""
-        delta = np.asarray(self.stats).astype(np.int64)
-        self.stats = zero_stats()
+        read and the fresh zero vector are frame-boundary transfers.
+
+        Tensor-parallel: the device vector is (tp, N_STATS), one row per
+        shard. Every row is replica-consistent by construction — each
+        shard's counters derive exclusively from replicated carry values
+        (emit masks, active masks, post-collective logits) — so the
+        steady-state read touches SHARD 0 ONLY (one small host read,
+        preserving the zero-in-frame-D2H budget per boundary). With
+        ``debug_replicas`` the read widens to all shards and ASSERTS they
+        agree, turning a hypothetical replication bug (a collective missed
+        somewhere in the forward) into a loud boundary failure instead of
+        silently skewed telemetry."""
+        if self.tp is None:
+            delta = np.asarray(self.stats).astype(np.int64)
+        elif self.debug_replicas:
+            rows = np.asarray(self.stats).astype(np.int64)   # (tp, N_STATS)
+            if not (rows == rows[0]).all():
+                raise AssertionError(
+                    "frame stats diverged across tp shards — a shard-"
+                    f"varying value leaked into the counters:\n{rows}")
+            delta = rows[0]
+        else:
+            shard0 = next(s for s in self.stats.addressable_shards
+                          if (s.index[0].start or 0) == 0)
+            delta = np.asarray(shard0.data).astype(np.int64).reshape(-1)
+        self.stats = self._fresh_stats()
         return delta
 
     def absorb(self, toks: np.ndarray, emit: np.ndarray, width: int):

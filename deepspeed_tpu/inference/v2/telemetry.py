@@ -80,10 +80,15 @@ STAT_NAMES = ("tokens_emitted", "active_row_steps", "prefill_tokens",
               "accepted_draft_tokens")
 
 
-def zero_stats():
-    """Fresh device stat vector for a frame carry."""
+def zero_stats(tp_degree=None):
+    """Fresh device stat vector for a frame carry — ``(N_STATS,)``, or the
+    per-shard ``(tp_degree, N_STATS)`` stack a tensor-parallel frame loop
+    carries (row r is shard r's accumulator; see
+    ``DeviceSlotTable.stats_delta``)."""
     import jax.numpy as jnp
-    return jnp.zeros((N_STATS,), jnp.int32)
+    if tp_degree is None:
+        return jnp.zeros((N_STATS,), jnp.int32)
+    return jnp.zeros((tp_degree, N_STATS), jnp.int32)
 
 
 # ---------------------------------------------------------------------------
@@ -240,7 +245,7 @@ class ServingTelemetry:
             "kv_blocks_total": 0,
             "occupancy": 0.0, "recompiled_programs": 0,
             "slo_risk": 0.0, "frame_steps_chosen": 0,
-            "last_recovery_ms": 0.0,
+            "last_recovery_ms": 0.0, "tp_degree": 1,
         }
         self.hists: Dict[str, LogBucketHistogram] = {
             n: LogBucketHistogram() for n in self.HIST_NAMES}
@@ -273,7 +278,8 @@ class ServingTelemetry:
         }
 
     def begin_serve(self, *, speculate: bool, gamma: int, adaptive: bool,
-                    n_slots: int, kv_blocks_total: int) -> None:
+                    n_slots: int, kv_blocks_total: int,
+                    tp_degree: int = 1) -> None:
         """Called by ``serve()`` at generator construction."""
         self.reset()
         self._gamma = gamma if speculate else 0
@@ -281,6 +287,7 @@ class ServingTelemetry:
         self.serve_view["spec"]["gamma"] = self._gamma
         self.gauges["slot_count"] = n_slots
         self.gauges["kv_blocks_total"] = kv_blocks_total
+        self.gauges["tp_degree"] = tp_degree
 
     def attach_monitor(self, monitor, every_frames: int = 1) -> None:
         """Fan out frame-boundary events through ``monitor.write_events``

@@ -292,7 +292,11 @@ def init_mlp(rng, cfg: TransformerConfig):
     return params, axes
 
 
-def apply_mlp(params, x, cfg: TransformerConfig):
+def apply_mlp(params, x, cfg: TransformerConfig, reduce=None):
+    """``reduce`` (tensor-parallel serving): applied to the w_out product
+    BEFORE the output bias — with the intermediate dim sharded, the product
+    is a partial sum the caller all-reduces, and the replicated bias must
+    be added exactly once (after the reduce), not once per shard."""
     dt = cfg.act_dtype
     mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
     if cfg.activation in ("swiglu", "geglu"):
@@ -310,6 +314,8 @@ def apply_mlp(params, x, cfg: TransformerConfig):
         else:  # "gelu" = tanh approximation (gelu_new); "gelu_exact" = erf
             h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
     y = jnp.einsum("bsf,fe->bse", h, params["wo"].astype(dt))
+    if reduce is not None:
+        y = reduce(y)
     if mlp_bias:
         y = y + params["bo"].astype(dt)
     return y

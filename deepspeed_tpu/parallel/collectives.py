@@ -1,0 +1,187 @@
+"""Latency-oriented tensor-parallel collectives for per-step decode.
+
+Tensor-parallel decode (DeepSpeed-Inference, arXiv 2207.00032) spends a
+growing share of each step in the two per-layer all-reduces (attention
+output, MLP output) plus the vocab-sharded logit all-gather: at decode batch
+sizes the matmuls are bandwidth-bound and short, so the collectives stop
+hiding behind compute. This module is the serving-side collective layer the
+``shard_map``-compiled frame loops call inside the manual region — three
+interchangeable lowerings per collective, picked by ``TPCollectives`` flags:
+
+- **exact** — ``lax.psum`` / ``lax.all_gather``. Bit-deterministic and the
+  default: the tp=1 vs tp=N greedy token-parity tests pin this path.
+- **overlap** (T3, arXiv 2401.16677) — the all-reduce decomposed into a
+  ring reduce-scatter + ring all-gather of ``degree`` chunks via
+  ``lax.ppermute``. One monolithic ``psum`` is an opaque scheduling unit;
+  2*(degree-1) small ppermute steps give XLA's latency-hiding scheduler
+  the freedom to interleave each hop with whatever neighboring compute is
+  independent of the not-yet-arrived chunks — the fusion T3 adds in
+  hardware, approximated at the scheduling level. Summation order differs
+  from ``psum`` (ring order), so this path is parity-at-tolerance, not
+  bit-exact.
+- **quantized** (EQuARX, arXiv 2506.17615) — int8 payloads with per-row
+  f32 scales for the activation all-reduces and the logit all-gather:
+  2-4x less inter-chip traffic per step in exchange for bounded error.
+  Tolerance contract: symmetric per-row quantization bounds the element
+  error by ``amax_row / 127`` per participating shard (the parity test in
+  ``tests/test_serving_tp.py`` asserts final logits within rtol=0.1 of the
+  exact path and that generation still completes).
+
+All functions must be called inside a ``shard_map`` manual region where
+``axis`` is a manual mesh axis; ``degree == 1`` short-circuits to identity.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+def psum_exact(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def all_gather_exact(x, axis: str, gather_axis: int = -1):
+    return jax.lax.all_gather(x, axis, axis=gather_axis, tiled=True)
+
+
+# ---------------------------------------------------------------------------
+# overlap path: ring all-reduce as ppermute chunks (T3-style)
+# ---------------------------------------------------------------------------
+
+
+def psum_ring(x, axis: str, degree: int):
+    """All-reduce as ring reduce-scatter + ring all-gather over ``degree``
+    chunks of the last dim, each hop an independent ``ppermute`` XLA can
+    schedule around neighboring compute. Falls back to ``psum`` when the
+    last dim doesn't split evenly (tiny tensors aren't worth chunking)."""
+    d = x.shape[-1]
+    if degree == 1:
+        return x
+    if d % degree != 0:
+        return jax.lax.psum(x, axis)
+    r = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % degree) for i in range(degree)]
+    chunks = x.reshape(x.shape[:-1] + (degree, d // degree))
+
+    def chunk(i):
+        # traced chunk index (depends on the shard's ring position)
+        return jax.lax.dynamic_index_in_dim(chunks, i % degree, axis=-2,
+                                            keepdims=False)
+
+    # reduce-scatter: the partial for chunk j starts at shard j+1 and
+    # accumulates one local contribution per hop, landing fully reduced on
+    # shard j after degree-1 hops — so shard r seeds chunk r-1 and adds the
+    # chunk matching each received partial (received index decreases by one
+    # per hop)
+    acc = chunk(r + degree - 1)
+    for k in range(1, degree):
+        acc = jax.lax.ppermute(acc, axis, perm) + chunk(r + 2 * degree - 1 - k)
+    # all-gather the reduced chunks back around the ring
+    parts = [acc]
+    for _ in range(degree - 1):
+        parts.append(jax.lax.ppermute(parts[-1], axis, perm))
+    # shard r produced chunk r and received chunk (r-1), (r-2), ... in turn;
+    # scatter them back to their chunk slots position-independently
+    out = jnp.zeros_like(chunks)
+    for k, p in enumerate(parts):
+        out = jax.lax.dynamic_update_index_in_dim(
+            out, p[..., None, :], (r - k) % degree, axis=-2)
+    return out.reshape(x.shape)
+
+
+# ---------------------------------------------------------------------------
+# quantized path: int8 payloads + per-row f32 scales (EQuARX-style)
+# ---------------------------------------------------------------------------
+
+
+def _quantize_int8(x):
+    """Symmetric per-row (last-dim) int8 quantization. Returns (q, scale)
+    with ``x ~= q * scale``; all-zero rows get scale 0 (q is 0 too, so the
+    dequantized product stays exactly 0 instead of NaN)."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.where(scale > 0, jnp.round(x.astype(jnp.float32)
+                                       / jnp.where(scale > 0, scale, 1.0)), 0)
+    return jnp.clip(q, -127, 127).astype(jnp.int8), scale
+
+
+def psum_quantized(x, axis: str, degree: int):
+    """All-reduce with int8 payloads: quantize the local partial sum,
+    exchange int8 + scales, dequantize-accumulate in the compute dtype.
+    Traffic: 1 byte/element + one f32 scale per row per shard."""
+    if degree == 1:
+        return x
+    q, s = _quantize_int8(x)
+    qg = jax.lax.all_gather(q, axis)                   # (degree, ...)
+    sg = jax.lax.all_gather(s, axis)
+    return jnp.sum(qg.astype(jnp.float32) * sg, axis=0).astype(x.dtype)
+
+
+def all_gather_quantized(x, axis: str, degree: int):
+    """Tiled all-gather of the LAST dim with int8 payloads (the per-step
+    logit exchange of a vocab-sharded LM head)."""
+    if degree == 1:
+        return x
+    q, s = _quantize_int8(x)                           # s: (..., 1)
+    qg = jax.lax.all_gather(q, axis, axis=q.ndim - 1, tiled=True)
+    sg = jax.lax.all_gather(s, axis, axis=s.ndim - 1, tiled=True)  # (..., tp)
+    shard = x.shape[-1]
+    deq = (qg.reshape(qg.shape[:-1] + (degree, shard)).astype(jnp.float32)
+           * sg[..., None])
+    return deq.reshape(qg.shape).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# the layer the frame loops call
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TPCollectives:
+    """Per-engine choice of collective lowerings (see module docstring).
+
+    ``quantized`` switches the activation all-reduces AND the logit
+    all-gather to int8 payloads; ``overlap`` switches the MLP all-reduce
+    (the one with downstream-independent compute to hide behind, per T3)
+    to the chunked ring. ``quantized`` wins when both are set — the int8
+    exchange is already chunk-shaped."""
+
+    axis: str
+    degree: int
+    quantized: bool = False
+    overlap: bool = False
+
+    def psum_attn(self, x):
+        """Attention-output (row-parallel wo) all-reduce."""
+        if self.degree == 1:
+            return x
+        if self.quantized:
+            return psum_quantized(x, self.axis, self.degree)
+        return psum_exact(x, self.axis)
+
+    def psum_mlp(self, x):
+        """MLP-output (row-parallel w_out) all-reduce — the overlap target."""
+        if self.degree == 1:
+            return x
+        if self.quantized:
+            return psum_quantized(x, self.axis, self.degree)
+        if self.overlap:
+            return psum_ring(x, self.axis, self.degree)
+        return psum_exact(x, self.axis)
+
+    def psum_embed(self, x):
+        """Vocab-sharded embedding-lookup reduce: always exact — each token
+        row is nonzero on exactly one shard, so this psum is a select, and
+        quantizing it would spend error budget for no traffic win."""
+        if self.degree == 1:
+            return x
+        return psum_exact(x, self.axis)
+
+    def gather_logits(self, x):
+        """Vocab-sharded logits (…, V/tp) -> (…, V)."""
+        if self.degree == 1:
+            return x
+        if self.quantized:
+            return all_gather_quantized(x, self.axis, self.degree)
+        return all_gather_exact(x, self.axis, gather_axis=-1)

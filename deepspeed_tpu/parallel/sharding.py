@@ -176,16 +176,56 @@ def tree_shardings(abstract_params, logical_tree, rules=BASE_RULES, mesh=None):
                         is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x))
 
 
-def tree_specs(abstract_params, logical_tree, rules=BASE_RULES, mesh=None):
+def tree_specs(abstract_params, logical_tree, rules=BASE_RULES, mesh=None,
+               min_shard_size: int = 2 ** 11):
     """Like :func:`tree_shardings` but returns raw PartitionSpecs."""
     if mesh is None:
         mesh = groups.get_mesh()
 
     def one(p, axes):
-        return shard_spec_for(p.shape, axes, rules, mesh)
+        return shard_spec_for(p.shape, axes, rules, mesh,
+                              min_shard_size=min_shard_size)
 
     return jax.tree.map(one, abstract_params, logical_tree,
                         is_leaf=lambda x: isinstance(x, tuple) and all(isinstance(a, (str, type(None))) for a in x))
+
+
+def inference_tp_specs(abstract_params, logical_tree, mesh: Mesh,
+                       axis: str = "tp", vocab_sharded: bool = True,
+                       rules=BASE_RULES):
+    """PartitionSpec tree for tensor-parallel SERVING over a 1-D mesh.
+
+    Same logical-axis vocabulary and rule set as training (BASE_RULES), with
+    the ``tensor`` mesh axis rebound to the serving mesh's ``axis`` — the
+    Megatron column/row layout falls out of the rules: heads/kv_heads/mlp
+    column-sharded, wo/w_out row-sharded (their contraction dim carries the
+    same logical axis), vocab-sharded embedding + LM head.
+
+    Differences from the training spec builders, both deliberate:
+
+    - NO min-size threshold. The ``shard_map``-compiled frame loops issue
+      manual per-layer collectives whose arithmetic assumes every heads/
+      kv_heads/mlp-carrying tensor is actually sharded — a silently
+      replicated wq would double-count in the attention-output psum. The
+      caller validates divisibility up front
+      (``model_implementations.archs.validate_tp_serving``) instead of
+      falling back per-tensor.
+    - ``vocab_sharded=False`` drops the vocab rule entirely (embedding and
+      LM head replicated, no logit all-gather) — the fallback for vocab
+      sizes the tp degree doesn't divide, which only costs memory, never
+      correctness.
+    """
+    eff = []
+    for la, ma in rules:
+        if ma == "tensor":
+            ma = axis
+        elif isinstance(ma, tuple):
+            ma = tuple(axis if m == "tensor" else m for m in ma)
+        if la == "vocab" and not vocab_sharded:
+            ma = None
+        eff.append((la, ma))
+    return tree_specs(abstract_params, logical_tree, rules=tuple(eff),
+                      mesh=mesh, min_shard_size=0)
 
 
 def batch_spec(mesh=None) -> P:

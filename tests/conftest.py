@@ -38,6 +38,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection serving tests "
         "(tests/test_serving_faults.py); included in tier-1")
+    # multichip tests run on the virtual 8-device CPU mesh this conftest
+    # already forces (--xla_force_host_platform_device_count=8), so they are
+    # tier-1-safe by construction and run in every PR; the marker exists so
+    # `-m multichip` can run the sharded-serving suite focused (the verify
+    # skill's forced-8-device job line)
+    config.addinivalue_line(
+        "markers", "multichip: exercises a multi-device mesh (virtual on "
+        "CPU); tier-1-safe, selectable with -m multichip")
 
 
 @pytest.fixture(autouse=True)
