@@ -1,0 +1,27 @@
+"""graft-lint: static analysis for the compiled serving stack.
+
+Six PRs of serving work (frame loop → speculation → telemetry → scheduler →
+faults → tensor parallelism) rest on invariants that were only checked
+*dynamically* — a transfer guard around ``dispatch_frame``, recompile-count
+assertions, tp parity suites. This package checks the same invariants
+*statically*, before a test run or a pod-slice deploy:
+
+- **Family A (jaxpr)** — trace the real serving programs on tiny abstract
+  shapes and walk the resulting ClosedJaxprs: no host-sync primitives
+  inside frames (GL001), donation-safe carry handoffs (GL002),
+  well-formed shard_map collectives and replica-invariant replicated
+  outputs (GL003), and trace-deterministic entry points (GL004).
+- **Family B (AST)** — lint ``deepspeed_tpu/`` source for retrace hazards:
+  Python branching on tracer values (GL101), unhashable static arguments
+  (GL102), dtype-promotion drift (GL103), host coercions in jitted code
+  (GL104), ``print`` in jitted code (GL105).
+
+CLI: ``python -m deepspeed_tpu.analysis.lint deepspeed_tpu/`` (or
+``bin/dstpu_lint``). See README "Static analysis".
+"""
+
+from .findings import (Finding, RULES, load_baseline, write_baseline,
+                       filter_baseline, suppressed_lines)
+
+__all__ = ["Finding", "RULES", "load_baseline", "write_baseline",
+           "filter_baseline", "suppressed_lines"]

@@ -1,0 +1,207 @@
+"""Finding/rule model, inline suppressions, and the committed baseline.
+
+A finding is one violation of one rule at one location. Locations are
+either source positions (Family B, and the AST half of GL002) or traced
+PROGRAMS (Family A — a jaxpr has no line number, so the program name is the
+location and the fingerprint context).
+
+Fingerprints are content-addressed, not line-addressed: ``rule | path |
+context | message`` — moving code around a file does not churn the
+baseline, changing what the code *does* does. The baseline file
+(``.graft-lint-baseline.json``) holds fingerprints of findings that are
+accepted as pre-existing; the CLI exits non-zero only on findings NOT in
+it. Per repo policy (ISSUE 7), real findings are fixed or inline-suppressed
+with a justification — the baseline exists for third-party sweeps and
+incremental adoption, and the committed one stays empty.
+
+Inline suppression::
+
+    x = float(steps)   # graft-lint: disable=GL104 -- steps is trace-static
+
+applies to the physical line it sits on; a comment-only line suppresses the
+next CODE line — further comment/blank lines in between (a multi-line
+justification) are skipped over.
+"""
+
+import dataclasses
+import hashlib
+import json
+import re
+from typing import Dict, Iterable, List, Set
+
+ERROR = "error"
+WARNING = "warning"
+
+#: rule id -> (short name, severity, what it catches, dynamic complement)
+RULES: Dict[str, tuple] = {
+    # ---- Family A: jaxpr-level invariant checks ----
+    "GL000": ("trace-failure", ERROR,
+              "serving program failed to trace for a reason no jaxpr rule "
+              "classifies — GL001-GL004 could not run, so a 'clean' result "
+              "would be vacuous for it",
+              "the serving suites themselves"),
+    "GL001": ("transfer-guard", ERROR,
+              "host-sync primitive (callback/debug print/host coercion) "
+              "reachable inside a compiled serving program",
+              "tests/*: frame_transfer_guard fixture "
+              "(jax.transfer_guard_device_to_host around dispatch_frame)"),
+    "GL002": ("donation-safety", ERROR,
+              "donated buffer with no matching output aval, or a dispatch "
+              "site that does not rebind every donated carry from the "
+              "call's results",
+              "donated-buffer errors at runtime; token-parity suites"),
+    "GL003": ("collective-structure", ERROR,
+              "collective naming an axis not manual on the enclosing "
+              "shard_map mesh, a non-permutation ppermute, or a "
+              "declared-replicated output that is shard-varying",
+              "tp_debug_replica_check=True per-boundary all-shard assert; "
+              "tests/test_serving_tp.py parity suites"),
+    "GL004": ("retrace-budget", ERROR,
+              "serving entry point whose jaxpr differs across two traces "
+              "of identical (bucket-compatible) shapes — a retrace per "
+              "call in production",
+              "compile_count_total() budgets in the serving tests"),
+    # ---- Family B: AST lint for retrace hazards ----
+    "GL101": ("tracer-branch", ERROR,
+              "Python `if`/`while`/`assert` on a traced value inside a "
+              "jitted function or scan body (ConcretizationTypeError, or "
+              "a silent retrace per distinct value)",
+              "recompile-count assertions in tests/test_frame_serving.py"),
+    "GL102": ("unhashable-static", ERROR,
+              "list/dict/set literal passed for a static jit argument "
+              "(unhashable cache key -> TypeError or a retrace per call)",
+              "compile_count() introspection in the serving tests"),
+    "GL103": ("dtype-drift", WARNING,
+              "float64-producing dtype in jitted code (dtype=float/"
+              "np.float64, np.float64()/astype(float)) — silently "
+              "downcast under x64-disabled, doubles traffic otherwise",
+              "parity-at-tolerance suites (tests/test_serving_tp.py)"),
+    "GL104": ("host-coercion", ERROR,
+              "float()/int()/bool()/.item()/.tolist()/np.* array "
+              "constructor on a value inside jitted code — a device sync "
+              "(or constant-folded garbage) in the compiled path",
+              "frame_transfer_guard fixture (in-frame D2H disallow)"),
+    "GL105": ("print-in-jit", WARNING,
+              "print() inside jitted code — runs once at trace time, "
+              "not per step (use jax.debug.print, which GL001 then "
+              "budgets)",
+              "none (trace-time only)"),
+}
+
+_SEV_ORDER = {ERROR: 0, WARNING: 1}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # file relative to the scanned target's parent dir
+    #                     (CWD-independent), or "<jaxpr>" for traced programs
+    line: int           # 1-based; 0 = program-level (no source position)
+    message: str
+    context: str = ""   # program name / symbol — stable fingerprint salt
+
+    @property
+    def severity(self) -> str:
+        return RULES[self.rule][1]
+
+    @property
+    def fingerprint(self) -> str:
+        raw = "|".join((self.rule, self.path, self.context, self.message))
+        return hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+    def render(self) -> str:
+        loc = f"{self.path}:{self.line}" if self.line else self.path
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{loc}: {self.rule} ({self.severity}){ctx}: {self.message}"
+
+    def as_json(self) -> Dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "context": self.context, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+
+def sort_findings(findings: Iterable[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (_SEV_ORDER[f.severity], f.path,
+                                           f.line, f.rule, f.message))
+
+
+# ---------------------------------------------------------------------------
+# inline suppressions
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(r"#\s*graft-lint:\s*disable=([A-Z0-9,\s]+?)"
+                          r"(?:\s--\s.*)?$")
+
+
+def suppressed_lines(source: str) -> Dict[int, Set[str]]:
+    """Map 1-based line number -> set of rule ids suppressed there.
+
+    A pragma on a code line covers that line; a pragma on a comment-only
+    line covers the line itself AND the next CODE line (the flake8
+    ``noqa``-above idiom) — intervening comment/blank lines, e.g. a
+    justification spilling onto a second comment line, are skipped."""
+    out: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        out.setdefault(i, set()).update(rules)
+        if text.lstrip().startswith("#"):
+            j = i + 1
+            while j <= len(lines) and (not lines[j - 1].strip()
+                                       or lines[j - 1].lstrip()
+                                       .startswith("#")):
+                out.setdefault(j, set()).update(rules)
+                j += 1
+            if j <= len(lines):
+                out.setdefault(j, set()).update(rules)
+    return out
+
+
+def apply_suppressions(findings: List[Finding],
+                       sources: Dict[str, str]) -> List[Finding]:
+    """Drop findings whose line carries a matching pragma. Program-level
+    findings (line 0) have no source line and cannot be pragma-suppressed —
+    fix them or baseline them."""
+    per_file: Dict[str, Dict[int, Set[str]]] = {}
+    kept = []
+    for f in findings:
+        if f.line and f.path in sources:
+            if f.path not in per_file:
+                per_file[f.path] = suppressed_lines(sources[f.path])
+            if f.rule in per_file[f.path].get(f.line, ()):
+                continue
+        kept.append(f)
+    return kept
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_VERSION = 1
+
+
+def load_baseline(path: str) -> Set[str]:
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(f"{path}: unrecognized baseline version "
+                         f"{data.get('version')!r}")
+    return set(data.get("fingerprints", []))
+
+
+def write_baseline(path: str, findings: Iterable[Finding]) -> None:
+    fps = sorted({f.fingerprint for f in findings})
+    with open(path, "w") as fh:
+        json.dump({"version": BASELINE_VERSION, "fingerprints": fps},
+                  fh, indent=2)
+        fh.write("\n")
+
+
+def filter_baseline(findings: List[Finding],
+                    baseline: Set[str]) -> List[Finding]:
+    return [f for f in findings if f.fingerprint not in baseline]

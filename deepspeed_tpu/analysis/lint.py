@@ -1,0 +1,223 @@
+"""graft-lint CLI.
+
+::
+
+    python -m deepspeed_tpu.analysis.lint deepspeed_tpu/ \
+        --baseline .graft-lint-baseline.json
+    bin/dstpu_lint --format json deepspeed_tpu/inference/
+
+Runs Family B (AST) over the given paths and Family A (jaxpr, the traced
+serving programs) unless ``--ast-only``; applies inline suppressions, then
+the baseline; exits 0 when no NEW findings remain, 1 otherwise, 2 on an
+internal error. ``--write-baseline`` records the current findings as
+accepted (repo policy: keep it empty — fix or inline-suppress instead).
+
+The jaxpr family needs a CPU backend with >= 8 devices to trace the
+tensor-parallel programs; the CLI forces the same virtual mesh the test
+suite uses, so it must set the environment BEFORE jax first imports —
+hence the lazy imports below.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+from .ast_checks import check_donation_sites, check_module
+from .findings import (RULES, Finding, apply_suppressions, filter_baseline,
+                       load_baseline, sort_findings, write_baseline)
+
+#: files whose dispatch sites must rebind donated carries (GL002 AST half)
+_DONATION_FILES = ("engine_v2.py", "ragged_manager.py")
+
+
+def _iter_py_files(target: str) -> List[str]:
+    if os.path.isfile(target) and target.endswith(".py"):
+        return [target]
+    out = []
+    if os.path.isdir(target):
+        for root, dirs, files in os.walk(target):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            out.extend(os.path.join(root, f) for f in sorted(files)
+                       if f.endswith(".py"))
+    return sorted(set(out))
+
+
+def _anchor_for(target: str) -> str:
+    """The directory finding paths are made relative to: the enclosing
+    REPO root (first parent holding .git/setup.py/pyproject.toml), so the
+    same file gets the same path — and the same baseline fingerprint —
+    whether the whole package or one changed file was scanned, from any
+    CWD. Outside any repo, fall back to the target's parent."""
+    d = target if os.path.isdir(target) else os.path.dirname(target)
+    probe = d
+    while True:
+        if any(os.path.exists(os.path.join(probe, m))
+               for m in (".git", "setup.py", "pyproject.toml")):
+            return probe
+        parent = os.path.dirname(probe)
+        if parent == probe:
+            # no repo marker anywhere above: anchor at the target's own
+            # directory, so a file scan and a scan of its containing dir
+            # still agree (deeper-nested dir scans cannot be reconciled
+            # without a marker — add one for stable baselines)
+            return d
+        probe = parent
+
+
+def run_ast_family(paths: List[str]) -> (List[Finding], Dict[str, str]):
+    """Finding paths are made relative to the enclosing repo root (see
+    ``_anchor_for``) — NOT the process CWD — so baseline fingerprints
+    match across invocation directories AND scan granularities."""
+    findings: List[Finding] = []
+    sources: Dict[str, str] = {}
+    seen = set()
+    for target in paths:
+        target = os.path.abspath(target)
+        anchor = _anchor_for(target)
+        for path in _iter_py_files(target):
+            if path in seen:
+                continue
+            seen.add(path)
+            rel = os.path.relpath(path, anchor)
+            try:
+                with open(path, encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError as e:
+                print(f"graft-lint: cannot read {rel}: {e}", file=sys.stderr)
+                continue
+            sources[rel] = src
+            findings.extend(check_module(rel, src))
+            if os.path.basename(path) in _DONATION_FILES:
+                findings.extend(check_donation_sites(rel, src))
+    return findings, sources
+
+
+def run_jaxpr_family(include_tp=None) -> List[Finding]:
+    """Trace the serving registry and run all four jaxpr checks. Imports
+    jax lazily — callers must have set the platform env first."""
+    import logging
+    logging.getLogger("DeepSpeedTPU").setLevel(logging.ERROR)
+    from .jaxpr_checks import check_program
+    from .programs import build_serving_programs
+    findings: List[Finding] = []
+    for prog in build_serving_programs(include_tp=include_tp):
+        findings.extend(check_program(prog))
+    return findings
+
+
+def _force_cpu_mesh() -> None:
+    """Same dance as tests/conftest.py: the jaxpr family traces shard_map
+    programs over a virtual 8-device CPU mesh; everything must be pinned
+    before jax initializes a backend."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + " --xla_force_host_platform_device_count=8"
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.analysis.lint",
+        description="graft-lint: static analysis for the compiled serving "
+                    "stack (jaxpr invariants + AST retrace hazards)")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to AST-lint (default: deepspeed_tpu/)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="accepted-findings file; only NEW findings fail")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="record current findings into --baseline and exit 0")
+    ap.add_argument("--ast-only", action="store_true",
+                    help="skip the jaxpr family (no tracing/engine builds; "
+                         "via bin/dstpu_lint this also skips the framework "
+                         "import entirely)")
+    ap.add_argument("--no-tp", action="store_true",
+                    help="skip the tensor-parallel (shard_map) programs")
+    ap.add_argument("--rules", metavar="GL001,GL101,...",
+                    help="restrict to these rule ids")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, (name, sev, what, dyn) in sorted(RULES.items()):
+            print(f"{rid}  {name:<22} {sev:<8} {what}")
+        return 0
+
+    paths = args.paths or [os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))]   # deepspeed_tpu/
+    for p in paths:
+        if not os.path.exists(p):
+            # a typo'd target must not report "clean" with 0 files scanned
+            print(f"graft-lint: no such file or directory: {p}",
+                  file=sys.stderr)
+            return 2
+    findings, sources = run_ast_family(paths)
+    if not args.ast_only:
+        try:
+            _force_cpu_mesh()
+            findings.extend(run_jaxpr_family(
+                include_tp=False if args.no_tp else None))
+        except Exception as e:            # noqa: BLE001
+            print(f"graft-lint: jaxpr family failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
+            return 2
+
+    findings = apply_suppressions(findings, sources)
+    if args.rules:
+        keep = {r.strip() for r in args.rules.split(",")}
+        findings = [f for f in findings if f.rule in keep]
+    findings = sort_findings(findings)
+
+    if args.write_baseline:
+        if not args.baseline:
+            ap.error("--write-baseline requires --baseline FILE")
+        write_baseline(args.baseline, findings)
+        print(f"graft-lint: wrote {len(findings)} fingerprint(s) to "
+              f"{args.baseline}", file=sys.stderr)
+        return 0
+
+    new = findings
+    if args.baseline:
+        # a missing or broken baseline must not silently degrade to a
+        # no-baseline run (every baselined finding would report as NEW) —
+        # and must not masquerade as "findings" either: exit 2, not 1
+        try:
+            new = filter_baseline(findings, load_baseline(args.baseline))
+        except (ValueError, OSError) as e:
+            print(f"graft-lint: cannot read baseline {args.baseline}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.format == "json":
+        print(json.dumps({
+            "findings": [f.as_json() for f in new],
+            "baselined": len(findings) - len(new),
+            "counts": _counts(new)}, indent=2))
+    else:
+        for f in new:
+            print(f.render())
+        base_note = (f" ({len(findings) - len(new)} baselined)"
+                     if len(findings) != len(new) else "")
+        if new:
+            counts = ", ".join(f"{k}={v}" for k, v in _counts(new).items())
+            print(f"graft-lint: {len(new)} finding(s){base_note}: {counts}")
+        else:
+            print(f"graft-lint: clean{base_note}")
+    return 1 if new else 0
+
+
+def _counts(findings: List[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.rule] = out.get(f.rule, 0) + 1
+    return dict(sorted(out.items()))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

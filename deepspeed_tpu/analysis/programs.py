@@ -1,0 +1,168 @@
+"""The serving-program registry: trace the REAL frame loops on tiny
+abstract shapes so Family A checks the programs production actually runs.
+
+``build_serving_programs()`` constructs a tiny f32 engine (and, when the
+process has >= 8 devices — the conftest/CLI force a virtual CPU mesh — a
+tp=8 twin plus self-draft speculative variants) and returns one
+``TracedProgram`` per serving entry point x shape bucket:
+
+- ``frame_loop`` at width=chunk (prefill frames) and width=1 (decode),
+- ``frame_loop_spec`` (speculative decode frames, gamma=2),
+- ``mixed_loop`` / ``mixed_loop_spec`` (the compiled-generation path),
+- ``decode_loop`` and the per-chunk ``run`` program.
+
+Tracing never compiles or executes — ``jit.trace`` stops at the jaxpr — so
+the whole registry costs seconds on CPU. Donation indices come from the
+live ``Traced.donate_argnums``, which is also what keeps
+``ast_checks.DISPATCH_DONATIONS`` honest (the test suite cross-checks the
+two).
+
+The tp programs are traced with the default EXACT collectives: the
+T3-style ring lowering (``tp_overlap_collectives``) is replica-invariant
+by ring algebra, not by local dataflow, so the GL003 taint pass cannot
+prove it — that lowering stays covered by the dynamic parity suites and
+``tp_debug_replica_check`` instead of a static false positive.
+"""
+
+import functools
+from typing import List, Optional
+
+from .jaxpr_checks import TracedProgram
+
+_GAMMA = 2
+
+
+def _tiny_engine(tp: int = 1):
+    import jax
+    from ..models import build_model
+    from ..inference.v2.engine_v2 import (InferenceEngineV2,
+                                          RaggedInferenceEngineConfig)
+    model = build_model("tiny", num_heads=8)
+    params = model.init(jax.random.PRNGKey(0))
+    cfg = RaggedInferenceEngineConfig(
+        kv_block_size=16, prefill_chunk_size=8, max_tokens_per_step=64,
+        max_ragged_batch_size=4, frame_steps=2, dtype="float32", tp=tp)
+    eng = InferenceEngineV2(model, cfg, params=params, max_seq_len=64)
+    eng.attach_draft(model, params)    # self-draft: spec loops traceable
+    return eng
+
+
+def _slot_table(eng):
+    import jax
+    from ..inference.v2.ragged_manager import DeviceSlotTable
+    return DeviceSlotTable(4, prompt_width=8, table_width=4,
+                           rng=jax.random.PRNGKey(0), tp=eng.tp_ctx)
+
+
+def _frame_args(eng, slots):
+    kv = eng.kv
+    return (eng.params, slots.prompts, slots.prompt_lens, slots.limits,
+            slots.eos_ids, slots.temps, slots.tables, slots.cached,
+            slots.produced, slots.last_tok, slots.done, slots.poison,
+            slots.nonfinite, slots.stats, slots.rng, kv.k, kv.v)
+
+
+def _spec_args(eng, slots):
+    kv, dkv = eng.kv, eng.draft_kv
+    return (eng.params, eng.draft_params, slots.prompts, slots.prompt_lens,
+            slots.limits, slots.eos_ids, slots.temps, slots.tables,
+            slots.cached, slots.produced, slots.last_tok, slots.penult,
+            slots.done, slots.poison, slots.nonfinite, slots.stats,
+            slots.rng, kv.k, kv.v, dkv.k, dkv.v)
+
+
+def _mixed_args(eng):
+    import jax
+    import jax.numpy as jnp
+    b, pmax = 2, 8
+    prompts = jnp.zeros((b, pmax), jnp.int32)
+    plens = jnp.full((b,), pmax, jnp.int32)
+    limits = jnp.full((b,), 4, jnp.int32)
+    tables = jnp.zeros((b, 4), jnp.int32)
+    rng = jax.random.PRNGKey(0)
+    return prompts, plens, limits, tables, rng, jnp.float32(0.0)
+
+
+def _program(name, builder, args, statics) -> TracedProgram:
+    """Wrap one jitted entry point. ``builder()`` must return a FRESH jit
+    every call (fresh trace, no jit-cache hit) — check_retrace depends on
+    it."""
+    def trace():
+        return builder().trace(*args, **statics)
+    prog = TracedProgram(name=name, trace=trace, retrace=trace)
+    try:
+        import bisect
+        import jax
+        tr = prog.traced()
+        # Traced.donate_argnums index the FLAT arg leaves (a param pytree
+        # expands to one index per leaf); keep those for the aval-matching
+        # check and ALSO map them back to user positional args for the
+        # DISPATCH_DONATIONS cross-check in tests/test_static_analysis.py
+        prog.donate_argnums = tuple(tr.donate_argnums)
+        bounds, total = [], 0
+        for a in args:
+            total += len(jax.tree_util.tree_leaves(a))
+            bounds.append(total)
+        prog.donate_user_args = tuple(sorted(
+            {bisect.bisect_right(bounds, i) for i in prog.donate_argnums}))
+    except Exception:          # noqa: BLE001 — checks surface it as findings
+        pass
+    return prog
+
+
+def _engine_programs(eng, tag: str) -> List[TracedProgram]:
+    import jax.numpy as jnp
+    runner, draft_runner = eng.runner, eng.draft_runner
+    slots = _slot_table(eng)
+    frame = functools.partial(_frame_args, eng, slots)
+    spec = functools.partial(_spec_args, eng, slots)
+    prompts, plens, limits, tables, rng, temp = _mixed_args(eng)
+    kv, dkv = eng.kv, eng.draft_kv
+    progs = [
+        _program(f"frame_loop[w=8]{tag}", runner._build_frame_loop, frame(),
+                 dict(width=8, steps=2, greedy=True)),
+        _program(f"frame_loop[w=1]{tag}", runner._build_frame_loop, frame(),
+                 dict(width=1, steps=2, greedy=True)),
+        _program(f"frame_loop_spec[w=1]{tag}",
+                 lambda: runner._build_frame_loop_spec(draft_runner), spec(),
+                 dict(width=1, steps=2, greedy=True, gamma=_GAMMA)),
+        _program(f"mixed_loop{tag}", runner._build_mixed_loop,
+                 (eng.params, prompts, plens, limits, kv.k, kv.v, tables,
+                  rng, temp),
+                 dict(chunk=8, wide_steps=1, narrow_steps=2, greedy=True)),
+        _program(f"mixed_loop_spec{tag}",
+                 lambda: runner._build_mixed_loop_spec(draft_runner),
+                 (eng.params, eng.draft_params, prompts, plens, limits,
+                  kv.k, kv.v, dkv.k, dkv.v, tables, rng, temp),
+                 dict(chunk=8, wide_steps=1, narrow_steps=2, greedy=True,
+                      gamma=_GAMMA)),
+    ]
+    if eng.tp_ctx is None:
+        # host-step paths never compile under shard_map; trace them once
+        last = jnp.zeros((2,), jnp.int32)
+        lens = jnp.full((2,), 8, jnp.int32)
+        progs.append(_program(
+            f"decode_loop{tag}", runner._build_decode_loop,
+            (eng.params, last, lens, tables, kv.k, kv.v, rng, temp),
+            dict(steps=2, greedy=True)))
+        ids = jnp.zeros((2, 8), jnp.int32)
+        pos = jnp.zeros((2, 8), jnp.int32)
+        valid = jnp.full((2,), 8, jnp.int32)
+        progs.append(_program(
+            f"run[chunk=8]{tag}", lambda: runner._build(8),
+            (eng.params, ids, pos, tables, valid, kv.k, kv.v), {}))
+    return progs
+
+
+def build_serving_programs(include_tp: Optional[bool] = None
+                           ) -> List[TracedProgram]:
+    """Trace every serving entry point; ``include_tp=None`` auto-detects
+    (>= 8 devices). Returns the registry the lint CLI and the repo
+    regression test both walk."""
+    import jax
+    progs = _engine_programs(_tiny_engine(tp=1), "")
+    if include_tp is None:
+        include_tp = len(jax.devices()) >= 8
+    if include_tp:
+        progs += _engine_programs(_tiny_engine(tp=8), "[tp=8]")
+    return progs

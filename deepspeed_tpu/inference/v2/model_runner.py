@@ -160,9 +160,9 @@ class PagedModelRunner:
             k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
             v = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wv"].astype(dt))
             if cfg.use_bias or cfg.qkv_bias:
-                q = q + lp["attn"]["bq"].astype(dt)
-                k = k + lp["attn"]["bk"].astype(dt)
-                v = v + lp["attn"]["bv"].astype(dt)
+                q = q + L.bcast(lp["attn"]["bq"].astype(dt), q.ndim)
+                k = k + L.bcast(lp["attn"]["bk"].astype(dt), k.ndim)
+                v = v + L.bcast(lp["attn"]["bv"].astype(dt), v.ndim)
             if cfg.qk_norm:
                 q = L.apply_qk_norm(lp["attn"]["q_norm"], q, cfg)
                 k = L.apply_qk_norm(lp["attn"]["k_norm"], k, cfg)
@@ -211,7 +211,7 @@ class PagedModelRunner:
             if tp is not None:
                 y = tp.coll.psum_attn(y)
             if "bo" in lp["attn"]:   # presence-keyed: out_bias may differ from use_bias
-                y = y + lp["attn"]["bo"].astype(dt)
+                y = y + L.bcast(lp["attn"]["bo"].astype(dt), y.ndim)
             if cfg.sandwich_norm:   # Gemma-2 post-attn output norm
                 y = L.apply_norm(lp["norm3"], y, cfg)
             if cfg.parallel_block:   # NeoX/Falcon: attn and mlp share input
@@ -289,7 +289,9 @@ class PagedModelRunner:
         else:
             logits = jnp.einsum(eq_untied, h_last, params["embed"]["lm_head"].astype(dt))
         if "lm_head_bias" in params["embed"]:
-            logits = logits + params["embed"]["lm_head_bias"].astype(logits.dtype)
+            logits = logits + L.bcast(
+                params["embed"]["lm_head_bias"].astype(logits.dtype),
+                logits.ndim)
         if cfg.logit_softcap:
             logits = cfg.logit_softcap * jnp.tanh(logits / cfg.logit_softcap)
         if tp is not None and tp.vocab_sharded:

@@ -29,6 +29,14 @@ def _ones(shape, dtype):
     return jnp.ones(shape, dtype)
 
 
+def bcast(w, ndim: int):
+    """Left-pad ``w`` with size-1 axes to rank ``ndim`` — the explicit form
+    of trailing-dim weight broadcasting ((B, S, E) op (E,) etc.), so the
+    serving forward stays legal under ``jax_numpy_rank_promotion="raise"``
+    (the GRAFT_SANITIZE suite mode and graft-lint's dtype/rank hygiene)."""
+    return w.reshape((1,) * (ndim - w.ndim) + w.shape)
+
+
 # ---- norms --------------------------------------------------------------
 
 def init_norm(cfg: TransformerConfig):
@@ -45,11 +53,13 @@ def apply_norm(params, x, cfg: TransformerConfig):
     if cfg.norm == "rmsnorm":
         var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
         y = x32 * jax.lax.rsqrt(var + cfg.norm_eps)
-        return (y * params["scale"].astype(jnp.float32)).astype(x.dtype)
+        return (y * bcast(params["scale"].astype(jnp.float32),
+                          y.ndim)).astype(x.dtype)
     mean = jnp.mean(x32, axis=-1, keepdims=True)
     var = jnp.var(x32, axis=-1, keepdims=True)
     y = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
-    y = y * params["scale"].astype(jnp.float32) + params["bias"].astype(jnp.float32)
+    y = (y * bcast(params["scale"].astype(jnp.float32), y.ndim)
+         + bcast(params["bias"].astype(jnp.float32), y.ndim))
     return y.astype(x.dtype)
 
 
@@ -72,7 +82,8 @@ def apply_rope(x, positions, inv_freq, *, interleaved=False):
     """
     rd = 2 * inv_freq.shape[0]
     rot = x[..., :rd].astype(jnp.float32)
-    angles = positions[..., None].astype(jnp.float32) * inv_freq  # (B, S, rd/2)
+    angles = (positions[..., None].astype(jnp.float32)
+              * inv_freq[None, None, :])                     # (B, S, rd/2)
     sin = jnp.sin(angles)[:, :, None, :]
     cos = jnp.cos(angles)[:, :, None, :]
     if interleaved:
@@ -188,9 +199,9 @@ def apply_qk_norm(norm_params, x, cfg: TransformerConfig):
         mean = jnp.mean(x32, axis=-1, keepdims=True)
         var = jnp.var(x32, axis=-1, keepdims=True)
         y = (x32 - mean) * jax.lax.rsqrt(var + cfg.norm_eps)
-    y = y * norm_params["scale"].astype(jnp.float32)
+    y = y * bcast(norm_params["scale"].astype(jnp.float32), y.ndim)
     if "bias" in norm_params:
-        y = y + norm_params["bias"].astype(jnp.float32)
+        y = y + bcast(norm_params["bias"].astype(jnp.float32), y.ndim)
     return y.reshape(b, s, h, d).astype(x.dtype)
 
 
@@ -214,9 +225,9 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
     k = jnp.einsum("bse,ehd->bshd", x, params["wk"].astype(dt))
     v = jnp.einsum("bse,ehd->bshd", x, params["wv"].astype(dt))
     if cfg.use_bias or cfg.qkv_bias:
-        q = q + params["bq"].astype(dt)
-        k = k + params["bk"].astype(dt)
-        v = v + params["bv"].astype(dt)
+        q = q + bcast(params["bq"].astype(dt), q.ndim)
+        k = k + bcast(params["bk"].astype(dt), k.ndim)
+        v = v + bcast(params["bv"].astype(dt), v.ndim)
     if cfg.qk_norm:
         q = apply_qk_norm(params["q_norm"], q, cfg)
         k = apply_qk_norm(params["k_norm"], k, cfg)
@@ -255,7 +266,7 @@ def apply_attention(params, x, cfg: TransformerConfig, *, positions=None, inv_fr
 
     y = jnp.einsum("bshd,hde->bse", out, params["wo"].astype(dt))
     if "bo" in params:
-        y = y + params["bo"].astype(dt)
+        y = y + bcast(params["bo"].astype(dt), y.ndim)
     return y, new_cache
 
 
@@ -308,7 +319,7 @@ def apply_mlp(params, x, cfg: TransformerConfig, reduce=None):
     else:
         h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
         if mlp_bias:
-            h = h + params["bi"].astype(dt)
+            h = h + bcast(params["bi"].astype(dt), h.ndim)
         if cfg.activation == "relu":
             h = jax.nn.relu(h)
         else:  # "gelu" = tanh approximation (gelu_new); "gelu_exact" = erf
@@ -317,7 +328,7 @@ def apply_mlp(params, x, cfg: TransformerConfig, reduce=None):
     if reduce is not None:
         y = reduce(y)
     if mlp_bias:
-        y = y + params["bo"].astype(dt)
+        y = y + bcast(params["bo"].astype(dt), y.ndim)
     return y
 
 
@@ -497,11 +508,18 @@ def apply_moe_grouped_ep(params, x, cfg: TransformerConfig, mesh):
         return out.reshape(b, s, e), aux
 
     tok_spec = P(batch_axes or None, seq_axis, None)
-    fn = jax.shard_map(
-        body, mesh=mesh,
-        in_specs=(P(), P("expert"), P("expert"), P("expert"), tok_spec),
-        out_specs=(tok_spec, P()),
-        axis_names=manual)
+    specs = dict(mesh=mesh,
+                 in_specs=(P(), P("expert"), P("expert"), P("expert"),
+                           tok_spec),
+                 out_specs=(tok_spec, P()))
+    if hasattr(jax, "shard_map"):          # jax>=0.8 surface
+        fn = jax.shard_map(body, axis_names=manual, **specs)
+    else:
+        # pre-0.8: manual axes are expressed as the complement (`auto`)
+        from jax.experimental.shard_map import shard_map as _sm
+        fn = _sm(body, check_rep=False,
+                 auto=frozenset(mesh.axis_names) - frozenset(manual),
+                 **specs)
     out, aux = fn(params["router"], params["wi_gate"], params["wi_up"],
                   params["wo"], x)
     if cfg.moe_shared_expert_size:

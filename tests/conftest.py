@@ -24,6 +24,114 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# GRAFT_SANITIZE=1 arms the dynamic sanitizers (see "sanitizer mode"
+# below): in-frame transfer guards on every serving test, strict rank
+# promotion, NaN debugging on non-fault suites, and per-suite retrace
+# budgets. Off by default so tier-1 timing is untouched.
+SANITIZE = os.environ.get("GRAFT_SANITIZE", "0") == "1"
+
+#: test modules that drive the frame serving loops — the suites the
+#: sanitizer applies the in-frame transfer guard and retrace budget to
+SERVING_SUITES = ("test_frame_serving", "test_serving_telemetry",
+                  "test_serving_scheduler", "test_serving_faults",
+                  "test_serving_tp")
+
+#: fault-injection suites intentionally produce NaN logits (poison rows):
+#: jax_debug_nans would abort the machinery under test
+NAN_SUITES = ("test_serving_faults",)
+
+#: per-suite ceiling on compiled programs PER RUNNER (compile_count_total —
+#: the monotonic recompile counter). Generous vs the handful of shape
+#: buckets a healthy suite compiles; a retrace-per-frame bug blows past it
+#: immediately. The static twin is graft-lint rule GL004.
+RETRACE_BUDGET = {"default": 64}
+
+
+def guard_frame_dispatch(monkeypatch):
+    """THE single definition of "in-frame": wrap
+    ``DeviceSlotTable.dispatch_frame`` in a device->host transfer guard.
+    Everything outside it (admission, absorb, stats_delta, quarantine
+    reads) is frame-BOUNDARY work and stays unguarded. Shared by the
+    ``frame_transfer_guard`` fixture (the dedicated per-suite guard tests)
+    and the GRAFT_SANITIZE=1 blanket mode, so the dynamic guard and the
+    static TransferGuard check (graft-lint GL001) agree on scope."""
+    from deepspeed_tpu.inference.v2.ragged_manager import DeviceSlotTable
+    orig = DeviceSlotTable.dispatch_frame
+
+    def guarded(self, *a, **kw):
+        with jax.transfer_guard_device_to_host("disallow"):
+            return orig(self, *a, **kw)
+
+    monkeypatch.setattr(DeviceSlotTable, "dispatch_frame", guarded)
+
+
+@pytest.fixture
+def frame_transfer_guard(monkeypatch):
+    """Opt-in fixture: the serving suites' zero-in-frame-transfer
+    acceptance tests request this instead of re-defining the guard."""
+    guard_frame_dispatch(monkeypatch)
+
+
+@pytest.fixture(autouse=True)
+def _sanitize(request, monkeypatch):
+    """Sanitizer mode (GRAFT_SANITIZE=1): every serving test runs under
+    the in-frame transfer guard, everything runs with strict rank
+    promotion, and non-fault tests run with jax_debug_nans — the dynamic
+    complements of graft-lint GL001/GL103 and the finite-check."""
+    if not SANITIZE:
+        yield
+        return
+    module = request.node.module.__name__.rsplit(".", 1)[-1]
+    if module not in SERVING_SUITES:
+        # the sanitizers police the SERVING stack's invariants; the
+        # training/ops suites have their own (looser) broadcasting idiom
+        yield
+        return
+    guard_frame_dispatch(monkeypatch)
+    prev_rank = jax.config.jax_numpy_rank_promotion
+    jax.config.update("jax_numpy_rank_promotion", "raise")
+    prev_nans = jax.config.jax_debug_nans
+    if module not in NAN_SUITES:
+        jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_numpy_rank_promotion", prev_rank)
+        jax.config.update("jax_debug_nans", prev_nans)
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _retrace_budget(request):
+    """Sanitizer mode: assert a per-suite retrace budget over every
+    PagedModelRunner the module creates, via the monotonic
+    ``compile_count_total()``. Catches the silent perf cliff (a retrace
+    per serve() call) that per-test recompile assertions can miss when
+    the engine is module-scoped."""
+    module = request.node.name.rsplit("/", 1)[-1].rsplit(".", 1)[0]
+    if not SANITIZE or module not in SERVING_SUITES:
+        yield
+        return
+    from deepspeed_tpu.inference.v2.model_runner import PagedModelRunner
+    runners = []
+    orig_init = PagedModelRunner.__init__
+
+    def tracking_init(self, *a, **kw):
+        orig_init(self, *a, **kw)
+        runners.append(self)
+
+    PagedModelRunner.__init__ = tracking_init
+    try:
+        yield
+    finally:
+        PagedModelRunner.__init__ = orig_init
+        budget = RETRACE_BUDGET.get(module, RETRACE_BUDGET["default"])
+        over = [(r, r.compile_count_total()) for r in runners
+                if r.compile_count_total() > budget]
+        assert not over, (
+            f"{module}: retrace budget exceeded — "
+            + ", ".join(f"runner compiled {n} programs (budget {budget}): "
+                        f"{r.compile_count()}" for r, n in over))
+
 
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`: anything wall-clock-sensitive (telemetry

@@ -1,0 +1,34 @@
+"""Family B fixture: one jitted function committing every AST-lintable
+retrace hazard. ``tests/test_static_analysis.py`` golden-matches the
+findings against the ``# expect: GLxxx`` markers, so rule drift shows up
+as a diff here, not as silence.
+
+This file is NEVER imported (np.zeros on a tracer would raise) — it is
+parsed only.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("flag",))
+def bad_jit(x, y, flag):
+    if x.sum() > 0:                           # expect: GL101
+        y = y + 1
+    while y.any():                            # expect: GL101
+        y = y - 1
+    z = float(x)                              # expect: GL104
+    w = np.zeros((4,))                        # expect: GL104
+    v = jnp.zeros((4,), dtype=np.float64)     # expect: GL103
+    print("tracing", flag)                    # expect: GL105
+    u = int(y)  # graft-lint: disable=GL104 -- fixture: suppression must hold
+    if flag:                                  # static arg: must NOT flag
+        z = z + 1
+    return z + w.sum() + v.sum() + u
+
+
+def caller():
+    return bad_jit(jnp.ones(3), jnp.ones(3), flag=[1, 2])   # expect: GL102

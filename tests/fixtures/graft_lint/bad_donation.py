@@ -1,0 +1,36 @@
+"""GL002 fixture (jaxpr half): a jit that donates a pool whose aval
+matches NO output — XLA can never reuse the buffer, so the donation buys
+nothing and the caller has still surrendered its reference. The serving
+loops donate 10-13 carries each; every one must round-trip through the
+outputs."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def bad_donate(pool, x):
+    return jnp.sum(pool) + x      # (8, 8) donated, only scalars returned
+
+
+def make_program():
+    from deepspeed_tpu.analysis.jaxpr_checks import TracedProgram
+
+    def trace():
+        return bad_donate.trace(jnp.zeros((8, 8), jnp.float32),
+                                jnp.zeros((), jnp.float32))
+
+    return TracedProgram(name="fixture:bad_donation", trace=trace,
+                         retrace=trace, donate_argnums=(0,))
+
+
+#: the AST half of GL002 — a dispatch that donates ``self.kv.k`` but keeps
+#: decoding from the stale reference (check_donation_sites flags the call
+#: because the donated argument is not among the assignment targets)
+BAD_DISPATCH_SRC = '''\
+def dispatch(self, runner, params):
+    toks, emit, new_k = runner.frame_loop(params, self.kv.k)
+    return toks, emit, self.kv.k      # reads the donated (dead) buffer
+'''

@@ -1,0 +1,30 @@
+"""GL004 fixture: an entry point whose trace depends on trace-time state —
+every trace with the SAME bucket-compatible shapes yields a different
+jaxpr, so in production the jit cache misses on every call and the frame
+pays a full retrace. The counter stands in for real offenders: fresh
+closures per call, dict/set iteration order, "just read the wall clock
+once" constants."""
+
+import jax
+import jax.numpy as jnp
+
+_TRACES = [0]
+
+
+def make_program():
+    from deepspeed_tpu.analysis.jaxpr_checks import TracedProgram
+
+    def build():
+        @jax.jit
+        def f(x):
+            _TRACES[0] += 1
+            if _TRACES[0] % 2:            # trace-time state leaks in
+                return x * 2.0
+            return x + 1.0
+        return f
+
+    def trace():
+        return build().trace(jnp.zeros((4,), jnp.float32))
+
+    return TracedProgram(name="fixture:bad_retrace", trace=trace,
+                         retrace=trace)

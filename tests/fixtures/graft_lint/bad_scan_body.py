@@ -1,0 +1,30 @@
+"""GL001 fixture: a serving-style scan loop whose body calls
+``jax.debug.print`` — a ``debug_callback`` host-sync primitive that would
+fire EVERY step of every frame. The real scan bodies
+(``model_runner._serving_scan_body``) must never contain one; this file is
+what the TransferGuard check looks like when they do."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("steps",))
+def bad_loop(carry, steps):
+    def body(c, _):
+        jax.debug.print("tok={}", c[0])   # the violation
+        return c + 1, c
+    carry, toks = jax.lax.scan(body, carry, None, length=steps)
+    return carry, toks
+
+
+def make_program():
+    from deepspeed_tpu.analysis.jaxpr_checks import TracedProgram
+    arr = jnp.zeros((4,), jnp.int32)
+
+    def trace():
+        return bad_loop.trace(arr, steps=3)
+
+    return TracedProgram(name="fixture:bad_scan_body", trace=trace,
+                         retrace=trace, donate_argnums=(0,))

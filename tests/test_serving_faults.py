@@ -29,7 +29,6 @@ from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
 from deepspeed_tpu.inference.v2.faults import (FaultInjector, FaultSpec,
                                                FrameDispatchError,
                                                InjectedFault)
-from deepspeed_tpu.inference.v2.ragged_manager import DeviceSlotTable
 from deepspeed_tpu.inference.v2.scheduler import (RequestScheduler,
                                                   SchedulerConfig)
 from deepspeed_tpu.models import build_model
@@ -219,18 +218,12 @@ def test_poison_row_quarantined_siblings_unaffected(
     _assert_clean(e)
 
 
-def test_finite_check_adds_no_in_frame_transfers(served_engine, monkeypatch):
+def test_finite_check_adds_no_in_frame_transfers(served_engine,
+                                                 frame_transfer_guard):
     """Acceptance guard: the finite-check/poison machinery rides the donated
     carry — frame dispatch performs ZERO device→host transfers even while a
-    poison fault fires and a quarantine runs."""
+    poison fault fires and a quarantine runs (conftest's shared guard)."""
     e = served_engine
-    orig = DeviceSlotTable.dispatch_frame
-
-    def guarded(self, *a, **kw):
-        with jax.transfer_guard_device_to_host("disallow"):
-            return orig(self, *a, **kw)
-
-    monkeypatch.setattr(DeviceSlotTable, "dispatch_frame", guarded)
     inj = FaultInjector([{"kind": "poison_row", "frame": 1, "uid": 1}])
     got = dict(e.serve(_arrivals(), max_new_tokens=8, faults=inj))
     assert 1 not in got and set(got) == {0, 2, 3}

@@ -29,7 +29,6 @@ from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
                                                   RaggedInferenceEngineConfig)
 from deepspeed_tpu.inference.v2.faults import (FaultInjector, FaultSpec,
                                                FrameDispatchError)
-from deepspeed_tpu.inference.v2.ragged_manager import DeviceSlotTable
 from deepspeed_tpu.models import build_model
 
 pytestmark = pytest.mark.multichip
@@ -126,19 +125,13 @@ def test_tp8_spec_greedy_parity(tp_model_params, greedy_base):
 
 
 def test_tp8_zero_in_frame_transfers(tp_model_params, greedy_base,
-                                     monkeypatch):
+                                     frame_transfer_guard):
     """Sharding must not smuggle device reads into the frame: dispatch
-    under a device-to-host transfer guard, with the per-shard stats rows
-    and replicated carry all surfacing at boundaries only."""
+    under a device-to-host transfer guard (conftest's shared definition of
+    "in-frame"), with the per-shard stats rows and replicated carry all
+    surfacing at boundaries only."""
     model, params = tp_model_params
     e = _engine(model, params, tp=8)
-    orig = DeviceSlotTable.dispatch_frame
-
-    def guarded(self, *a, **kw):
-        with jax.transfer_guard_device_to_host("disallow"):
-            return orig(self, *a, **kw)
-
-    monkeypatch.setattr(DeviceSlotTable, "dispatch_frame", guarded)
     got = dict(e.serve(iter([[(0, PROMPTS[0]), (1, PROMPTS[1])]]),
                        max_new_tokens=MAX_NEW))
     for u in (0, 1):
