@@ -395,6 +395,10 @@ DISPATCH_DONATIONS: Dict[str, Tuple[int, ...]] = {
     "mixed_loop_spec": (6, 7, 8, 9),
     "decode_loop": (4, 5),
     "run": (6, 7),
+    # KV memory-hierarchy page movers (kv_cache.py): both donate the two
+    # pools they rewrite in place (COW copies / swap-in restores)
+    "copy_blocks": (0, 1),
+    "scatter_pages": (0, 1),
 }
 
 

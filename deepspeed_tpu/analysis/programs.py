@@ -151,6 +151,25 @@ def _engine_programs(eng, tag: str) -> List[TracedProgram]:
         progs.append(_program(
             f"run[chunk=8]{tag}", lambda: runner._build(8),
             (eng.params, ids, pos, tables, valid, kv.k, kv.v), {}))
+        # KV memory-hierarchy page movers (kv_cache.py / kv_hierarchy.py):
+        # the frame-BOUNDARY device programs behind copy-on-write block
+        # copies and host-RAM swap restores — donation- and transfer-
+        # checked exactly like the frame loops (they run between frames,
+        # so a host-sync primitive inside one would still be a boundary
+        # stall worth catching; identical program under tp via GSPMD)
+        from ..inference.v2.kv_cache import BlockedKVCache
+        bids = jnp.zeros((2,), jnp.int32)
+        pages = jnp.zeros((kv.num_layers, kv.kv_heads, 2, kv.block_size,
+                           kv.head_dim), kv.k.dtype)
+        progs.append(_program(
+            f"copy_blocks{tag}", BlockedKVCache._build_copy_blocks,
+            (kv.k, kv.v, bids, bids), {}))
+        progs.append(_program(
+            f"scatter_pages{tag}", BlockedKVCache._build_scatter_pages,
+            (kv.k, kv.v, bids, pages, pages), {}))
+        progs.append(_program(
+            f"gather_pages{tag}", BlockedKVCache._build_gather_pages,
+            (kv.k, kv.v, bids), {}))
     return progs
 
 

@@ -6,6 +6,7 @@ and chaos/recovery harnesses without reaching into module internals."""
 
 from .faults import (FaultInjector, FaultReason,  # noqa: F401
                      FaultSpec, FrameDispatchError, InjectedFault)
+from .kv_hierarchy import KVSwapTier, PrefixCache  # noqa: F401
 from .scheduler import (RequestScheduler, SchedulerConfig,  # noqa: F401
                         ShedReason)
 from .telemetry import LogBucketHistogram, ServingTelemetry  # noqa: F401

@@ -17,6 +17,7 @@ frame boundaries).
 
 import collections
 import dataclasses
+import math
 import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -122,6 +123,30 @@ class RaggedInferenceEngineConfig:
     # and assert they agree (replica-consistency proof); steady state reads
     # shard 0 only
     tp_debug_replica_check: bool = False
+    # ---- KV memory hierarchy (kv_hierarchy.py; README "KV memory
+    # hierarchy") ----
+    # prefix cache with copy-on-write block sharing: admission maps a new
+    # prompt's published prefix blocks read-only into its block table and
+    # starts prefill at the first uncached position (greedy outputs stay
+    # token-identical cache-on vs cache-off; all device touches are frame-
+    # boundary-only). Off by default: cache-held blocks outlive requests,
+    # which changes the pool-drain invariant callers may rely on.
+    prefix_cache: bool = False
+    # cap on device blocks the prefix cache may pin (LRU-evicts — spilling
+    # to the swap tier when one is configured — beyond it); None = bounded
+    # only by pool pressure (admission reclaims cold entries on demand)
+    prefix_cache_max_blocks: Optional[int] = None
+    # host-RAM swap tier on the swap_tensor machinery: a directory for
+    # swapped KV pages (tmpfs/ramdisk for a true RAM tier). When set,
+    # scheduler preemption swaps the victim's committed pages out and
+    # re-admission swaps them back in (replacing re-prefill), cold prefix
+    # blocks spill instead of dropping, and crash recovery restores pages
+    # (the tier's index persists beside the pages, so a fresh engine
+    # sharing the directory resumes without recomputing). None disables.
+    kv_swap_dir: Optional[str] = None
+    # preemption swaps committed KV instead of re-prefilling (needs
+    # kv_swap_dir; False keeps the PR-4 re-prefill path)
+    kv_swap_preempt: bool = True
     dtype: str = "bfloat16"
 
 
@@ -177,6 +202,21 @@ class InferenceEngineV2:
         self._ledger: Dict[int, LedgerEntry] = {}
         self._resume_pending: set = set()
         self._clock = time.monotonic
+        # KV memory hierarchy (kv_hierarchy.py): host-RAM swap tier +
+        # prefix cache with copy-on-write block sharing. Both default off;
+        # the cache rides the refcounted allocator, so cache-off paths are
+        # untouched (every allocate is ref 1, every free releases).
+        self.kv_swap = None
+        self.prefix_cache = None
+        if c.kv_swap_dir:
+            from .kv_hierarchy import KVSwapTier
+            self.kv_swap = KVSwapTier(c.kv_swap_dir)
+        if c.prefix_cache:
+            from .kv_hierarchy import PrefixCache
+            self.prefix_cache = PrefixCache(
+                self.kv, max_blocks=c.prefix_cache_max_blocks,
+                swap=self.kv_swap)
+        self._pc_stats_base: Optional[Dict] = None
         # tensor-parallel serving context (tp.TPContext): set up BEFORE any
         # draft attach so the draft shards onto the same mesh
         self.tp_ctx = None
@@ -280,6 +320,10 @@ class InferenceEngineV2:
         # re-attach must evict them or the old draft would keep running
         # (evict() folds their programs into the monotonic compile total)
         self.runner.evict("spec_frame", "spec_mixed")
+        if self.prefix_cache is not None:
+            # spilled prefix pages now carry the draft pool's page too,
+            # so a restored block keeps draft acceptance
+            self.prefix_cache.draft_kv = self.draft_kv
         log_dist(f"InferenceEngineV2: draft attached "
                  f"(layers={dcfg.num_layers} gamma={c.speculate_gamma})",
                  ranks=[0])
@@ -759,7 +803,18 @@ class InferenceEngineV2:
             debug_replicas=c.tp_debug_replica_check)
         if faults is not None:
             faults.begin_serve()     # rearm the scripted schedule
+        if self.prefix_cache is not None:
+            # telemetry counters reset per serve run; rebase the cache's
+            # cumulative bookkeeping so the first boundary's delta doesn't
+            # absorb a previous run's history
+            self._pc_stats_base = dict(self.prefix_cache.stats)
         resume = self._resume_entries(resume_from)
+        if self.kv_swap is not None:
+            # swap records exist solely for re-admission: a run that will
+            # not resume a uid has abandoned its pages — release them so
+            # a crash/restart cycle can't accumulate dead pages in the
+            # tier (records created by THIS run's preemptions come later)
+            self.kv_swap.prune_requests({r[0] for r in resume})
         self._ledger = {}
         self._resume_pending = {r[0] for r in resume}
         self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
@@ -927,7 +982,8 @@ class InferenceEngineV2:
         returned are simply re-generated). Sampled (temperature > 0) rows
         resume correctly but not bit-identically — the frame RNG restarts.
         """
-        return snapshot_ledger(self._ledger, self.state.seqs, self._clock)
+        return snapshot_ledger(self._ledger, self.state.seqs, self._clock,
+                               swap_tier=self.kv_swap)
 
     def _ledger_add(self, uid, toks, limit, temp, eos, deadline_ms,
                     tenant=None, priority=None, slo_ms=None,
@@ -969,6 +1025,7 @@ class InferenceEngineV2:
         count it — the request is NOT yielded and NOT counted as a normal
         retirement."""
         ent = self._ledger.pop(uid, None)
+        self._drop_swap(uid)
         if ent is not None:
             tenant = tenant or ent.tenant
             priority = priority if priority is not None else ent.priority
@@ -1034,6 +1091,11 @@ class InferenceEngineV2:
             slots.evict(uid)
             if sched is not None:
                 sched.on_retire(uid)
+            if self.prefix_cache is not None:
+                # pages published by a row whose logits went non-finite
+                # may themselves hold non-finite KV — never hand them to
+                # a healthy request
+                self.prefix_cache.invalidate_uid(uid)
             self.state.flush_sequence(uid)
             self._fault_retire(
                 uid, "poison_row", frame,
@@ -1108,6 +1170,214 @@ class InferenceEngineV2:
         if not self._resume_pending:
             self.telemetry.on_recover(
                 n_resumed, (self._clock() - resume_t0) * 1e3)
+
+    # ------------------------------------------------------------------
+    # KV memory hierarchy (kv_hierarchy.py): prefix-cache admission,
+    # copy-on-write, boundary publishing, swap-tier restore
+    # ------------------------------------------------------------------
+
+    def _drop_swap(self, uid: int) -> None:
+        """Drop a request's swap-tier record at terminal retirement (the
+        record was either consumed by a swap-in or is now stale). NOT
+        called on generator abandonment after a crash — the tier must
+        outlive the engine so ``serve(resume_from=)`` can restore pages."""
+        if self.kv_swap is not None:
+            self.kv_swap.drop_request(uid)
+
+    def _admit_capacity(self, uid: int, seq, toks, limit: int,
+                        boundary: int) -> Optional[int]:
+        """Reserve KV capacity for one admission. Returns the admission
+        watermark ``cached0`` (tokens whose pages are already valid — 0 on
+        the cold path) or None when the pool cannot hold the request yet.
+
+        With the hierarchy off this is exactly the old
+        ``ensure_capacity`` probe. With it on, in order of preference:
+        (1) a preempted/crashed victim whose committed pages sit in the
+        host swap tier restores them into fresh blocks (replacing
+        re-prefill); (2) a prompt matching published prefix blocks maps
+        them read-only (copy-on-write for a mid-block divergence); (3)
+        cold. Capacity failures first try reclaiming cold unreferenced
+        cache blocks. A deferred request KEEPS its mapped shared blocks
+        (refcount bumps, zero pool cost) and its ``resume_cached`` mark,
+        so the retry at the next boundary resumes where it left off."""
+        total = len(toks) + limit + 1
+        if self.prefix_cache is None and self.kv_swap is None:
+            return 0 if self.state.ensure_capacity(seq, total) else None
+        chunk = self._config.prefill_chunk_size
+        # --- (1) swap-in re-admission ---
+        if self.kv_swap is not None and not seq.blocks:
+            from .kv_hierarchy import token_fingerprint
+            rec = self.kv_swap.request_record(uid)
+            # the record's pages cover the first rec["tokens"] tokens of
+            # the folded stream at eviction — a prefix of ``toks`` by
+            # construction (a queued victim emits nothing). The CONTENT
+            # fingerprint is re-validated too: a reused uid with a fresh
+            # prompt must never restore another request's pages
+            if rec is not None and not (
+                    0 < rec["tokens"] <= len(toks)
+                    and rec.get("fingerprint") ==
+                    token_fingerprint(toks[:rec["tokens"]])):
+                self.kv_swap.drop_request(uid)     # stale: uid was reused
+                rec = None
+            if rec is not None:
+                if not self._ensure_capacity_reclaim(seq, total):
+                    return None      # record kept: retry next boundary
+                try:
+                    self.kv_swap.restore_request(
+                        uid, self.kv, seq.blocks[:rec["blocks"]],
+                        draft_kv=self.draft_kv)
+                except Exception as e:   # noqa: BLE001 — fall back
+                    self.kv_swap.drop_request(uid)
+                    self._fault_event(
+                        "swap_failed", boundary,
+                        f"uid={uid}: page restore failed "
+                        f"({type(e).__name__}: {e}); re-prefilling")
+                else:
+                    self.kv_swap.drop_request(uid)
+                    cached0 = (min(rec["tokens"], len(toks) - 1)
+                               // chunk * chunk)
+                    seq.resume_cached = cached0
+                    self.telemetry.on_kv_swap_in(
+                        rec["blocks"], resume=uid in self._resume_pending)
+                    return cached0
+        # --- (2) prefix-cache hit (first probe only: a deferred HIT
+        # retry already holds its mapped blocks, and a deferred miss must
+        # not count a fresh lookup per boundary) ---
+        cached0 = seq.resume_cached
+        if self.prefix_cache is not None and not seq.blocks \
+                and not seq.hier_probed:
+            seq.hier_probed = True
+            cached0 = self._prefix_map(seq, toks)
+        # --- (3) fresh blocks for everything past the mapped prefix ---
+        if not self._ensure_capacity_reclaim(seq, total):
+            return None
+        seq.resume_cached = cached0
+        return cached0
+
+    def _ensure_capacity_reclaim(self, seq, total: int) -> bool:
+        """``ensure_capacity`` with one retry after evicting cold
+        unreferenced prefix-cache blocks (spilled to the swap tier when
+        one is configured — KV pressure spills instead of shedding)."""
+        if self.state.ensure_capacity(seq, total):
+            return True
+        if self.prefix_cache is not None:
+            need = self.kv.blocks_for(total) - len(seq.blocks) \
+                - self.kv.free_blocks
+            if need > 0 and self.prefix_cache.reclaim(need) > 0 \
+                    and self.state.ensure_capacity(seq, total):
+                return True
+        return False
+
+    def _prefix_map(self, seq, toks) -> int:
+        """Map the longest usable published prefix into ``seq.blocks``:
+        full blocks below the (chunk-aligned) admission watermark are
+        shared read-only; a hit ending mid-block copies that page
+        (copy-on-write) so the divergent continuation writes a private
+        copy. Returns the watermark (0 = miss). Chunk alignment makes a
+        hit admission replay the exact prefill chunk boundaries of a cold
+        one, keeping greedy outputs token-identical cache-on vs -off."""
+        pc = self.prefix_cache
+        tel = self.telemetry
+        alloc = self.kv.allocator
+        bs = self.kv.block_size
+        chunk = self._config.prefill_chunk_size
+        full, partial = pc.match(toks)
+        # every matched entry is still refcount-1 until mapped below —
+        # protect the whole chain so one entry's swap-restore cannot
+        # reclaim a chain-mate this same admission is about to share
+        protect = {e.eid for e in full} | \
+            ({partial[0].eid} if partial else set())
+        usable = []
+        for e in full:
+            if not pc.ensure_resident(e, protect=protect):
+                break
+            usable.append(e)
+        partial_ok = partial if (
+            partial is not None and len(usable) == len(full)
+            and pc.ensure_resident(partial[0], protect=protect)) else None
+        matched = len(usable) * bs + (partial_ok[1] if partial_ok else 0)
+        cached0 = min(matched, len(toks) - 1) // chunk * chunk
+        n_full, mid = cached0 // bs, cached0 % bs
+        chain = usable + ([partial_ok[0]] if partial_ok else [])
+        if mid and alloc.free_blocks < 1 and \
+                not pc.reclaim(1, protect={e.eid for e in chain}):
+            # no page for the COW copy: shrink the hit to whole blocks,
+            # aligned to BOTH the block and the chunk (chunk need not
+            # divide the block size) so mid comes out 0 — anything else
+            # would re-derive a COW against a pool known to be empty
+            align = bs * chunk // math.gcd(bs, chunk)
+            cached0 = n_full * bs // align * align
+            n_full, mid = cached0 // bs, 0
+        if cached0 <= 0:
+            tel.on_prefix_lookup(0, 0, False)
+            return 0
+        shared = [e.block for e in chain[:n_full]]
+        alloc.share(shared)
+        seq.blocks.extend(shared)
+        if mid:
+            src = chain[n_full].block
+            dst = alloc.allocate(1)[0]
+            self.kv.k, self.kv.v = self.kv.copy_blocks(
+                self.kv.k, self.kv.v, [src], [dst])
+            if self.draft_kv is not None:
+                self.draft_kv.k, self.draft_kv.v = self.draft_kv.copy_blocks(
+                    self.draft_kv.k, self.draft_kv.v, [src], [dst])
+            seq.blocks.append(dst)
+            pc.stats["cow_copies"] += 1
+        pc.touch(chain[:n_full + (1 if mid else 0)], cached0)
+        tel.on_prefix_lookup(cached0, n_full + (1 if mid else 0), mid > 0)
+        # record the watermark ON THE DESCRIPTOR the moment blocks are
+        # mapped: if the remainder reservation defers this admission, the
+        # retry must resume at cached0 — prefilling from 0 would WRITE
+        # into the shared (published, read-only) pages
+        seq.resume_cached = cached0
+        # the mapped full blocks ARE published entries: seed the publish
+        # cursor so this row's first boundary publish resumes after them
+        # instead of re-hashing the whole shared prefix
+        seq.published_upto = n_full * bs
+        seq.publish_parent = chain[n_full - 1].eid if n_full else -1
+        return cached0
+
+    def _publish_prefixes(self, slots) -> None:
+        """Frame-boundary publish: every live row's full blocks below its
+        committed watermark enter the prefix index (content below the
+        watermark is final — sharing is read-only by construction). Also
+        syncs the cache's bookkeeping deltas into the telemetry counters."""
+        pc = self.prefix_cache
+        if pc is None:
+            return
+        bs = self.kv.block_size
+        for uid, slot in list(slots.slot_of_uid.items()):
+            seq = self.state.seqs.get(uid)
+            ent = self._ledger.get(uid)
+            if seq is None or ent is None or not seq.blocks:
+                continue
+            w = int(slots.cached_h[slot])
+            lo = seq.published_upto // bs * bs
+            if w // bs * bs <= lo:
+                continue                     # no newly committed full block
+            # hand publish only the UNPUBLISHED suffix of the stream — a
+            # long-context row's boundary publish must not re-copy its
+            # whole prompt+generated history every block
+            pl = len(ent.prompt)
+            seg = seq.generated[lo - pl:] if lo >= pl \
+                else ent.prompt[lo:] + seq.generated
+            _, seq.publish_parent, d_done = pc.publish(
+                uid, seg, seq.blocks, w, start_depth=lo // bs,
+                parent=seq.publish_parent)
+            # advance only as far as the walk actually got: an early stop
+            # (cache at capacity, or a reclaimed chain position) must
+            # retry those depths, never skip them
+            seq.published_upto = d_done * bs
+        s = dict(pc.stats)
+        base = self._pc_stats_base or {k: 0 for k in s}
+        self.telemetry.on_prefix_update(
+            s["published"] - base["published"],
+            s["evicted"] - base["evicted"],
+            s["swapped_out"] - base["swapped_out"],
+            s["swapped_in"] - base["swapped_in"],
+            pc.resident_blocks())
+        self._pc_stats_base = s
 
     def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
                     temperature, eos_token_id, speculate=False, gamma=0,
@@ -1193,7 +1463,9 @@ class InferenceEngineV2:
                     and len(admits) < slots.free_slots():
                 uid, toks, limit, temp, eos = pending[0]
                 seq = self.state.get_or_create_sequence(uid)
-                if not self.state.ensure_capacity(seq, len(toks) + limit + 1):
+                cached0 = self._admit_capacity(uid, seq, toks, limit,
+                                               boundary)
+                if cached0 is None:
                     if slots.live_count() == 0 and not admits:
                         raise RuntimeError(
                             f"uid={uid}: prompt + budget can never fit the "
@@ -1202,7 +1474,7 @@ class InferenceEngineV2:
                     break        # wait for retirements to free blocks
                 pending.popleft()
                 seq.done = False
-                admits.append((uid, seq, toks, limit, temp, eos))
+                admits.append((uid, seq, toks, limit, temp, eos, cached0))
                 tel.on_admit(uid)
             if pending:
                 # overload is otherwise invisible: the deferred arrivals
@@ -1264,6 +1536,7 @@ class InferenceEngineV2:
                 seq.seen_tokens = int(
                     slots.committed_h[slots.slot_of_uid[uid]])
                 tel.on_emit(uid, len(new_toks))
+            self._publish_prefixes(slots)
             for uid in finished:
                 seq = self.state.seqs[uid]
                 seq.done = True
@@ -1271,6 +1544,7 @@ class InferenceEngineV2:
                 slots.retire(uid)
                 self.state.flush_sequence(uid)
                 self._ledger.pop(uid, None)
+                self._drop_swap(uid)
                 tel.on_retire(uid)
                 yield uid, out
 
@@ -1278,12 +1552,15 @@ class InferenceEngineV2:
     # SLO-aware scheduled serving (scheduler.RequestScheduler)
     # ------------------------------------------------------------------
 
-    def _evict_to_queue(self, uid, slots, sched):
+    def _evict_to_queue(self, uid, slots, sched, boundary: int = -1):
         """Preempt a live row at a frame boundary: freeze its device slot,
         release its KV blocks, fold its emitted tokens into the request's
-        prompt (re-admission re-prefills the committed prefix — token-
-        identical under greedy decoding), and re-queue it at the front of
-        its class/tenant queue."""
+        prompt, and re-queue it at the front of its class/tenant queue.
+        Re-admission re-prefills the committed prefix — token-identical
+        under greedy decoding — unless the host-RAM swap tier is on, in
+        which case the victim's committed pages are swapped OUT here (one
+        boundary D2H read per pool) and swapped back IN at re-admission,
+        replacing the re-prefill with a page restore."""
         from .scheduler import PRIORITY_NAMES
         seq = self.state.seqs[uid]
         req = sched.on_evict(uid)
@@ -1293,7 +1570,30 @@ class InferenceEngineV2:
                 [np.asarray(req.tokens, np.int32),
                  np.asarray(emitted, np.int32)])
             req.limit -= len(emitted)
+        if self.kv_swap is not None and self._config.kv_swap_preempt \
+                and seq.blocks:
+            # committed watermark: pages cover the first w tokens of the
+            # folded stream (the newest emitted token rides ``last_tok``
+            # and is NOT in KV yet, so w == len(req.tokens) - 1 for a
+            # decode-phase victim; mid-prefill victims sit lower)
+            w = int(slots.committed_h[slots.slot_of_uid[uid]])
+            n = self.kv.blocks_for(w)
+            if 0 < w <= len(req.tokens) and n <= len(seq.blocks):
+                from .kv_hierarchy import token_fingerprint
+                try:
+                    self.kv_swap.put_request(
+                        uid, w, self.kv, seq.blocks[:n],
+                        draft_kv=self.draft_kv,
+                        fingerprint=token_fingerprint(req.tokens[:w]))
+                    self.telemetry.on_kv_swap_out(n)
+                except Exception as e:   # noqa: BLE001 — re-prefill instead
+                    self._fault_event(
+                        "swap_failed", boundary,
+                        f"uid={uid}: page swap-out failed "
+                        f"({type(e).__name__}: {e}); victim will re-prefill")
         slots.evict(uid)
+        seq.resume_cached = 0           # the mapped pages are going away
+        seq.hier_probed = False         # re-admission probes the cache anew
         if seq.blocks:
             self.kv.allocator.free(seq.blocks)
             seq.blocks = []
@@ -1349,16 +1649,15 @@ class InferenceEngineV2:
                 [np.asarray(prompt, np.int32),
                  np.asarray(generated, np.int32)]) if generated else \
                 np.asarray(prompt, np.int32)
-            shed = sched.submit(Request(
+            # bypass_quota: this request was already ACCEPTED by the
+            # crashed run (known issue (a) — tenant_max_queued must not
+            # shed mid-flight work on resume and drop its committed
+            # tokens). The quota is submit()'s only shed, so a bypassed
+            # submit never sheds — no rejection handling needed here.
+            sched.submit(Request(
                 uid=uid, tokens=folded, limit=remaining, temp=temp,
-                eos=eos, tenant=tenant, priority=prio, slo_ms=slo_ms))
-            if shed is not None:
-                tel.on_shed(uid, shed.tenant, shed.priority, shed.reason)
-                self._ledger.pop(uid, None)
-                # unlike a shed NEW arrival (no descriptor yet), the resume
-                # ingestion created this descriptor above — drop it or the
-                # uid could never be reused
-                self.state.flush_sequence(uid)
+                eos=eos, tenant=tenant, priority=prio, slo_ms=slo_ms),
+                bypass_quota=True)
         while True:
             boundary += 1
             # ---- poll the arrival clock ----
@@ -1408,9 +1707,10 @@ class InferenceEngineV2:
                             shed.reason)
                 # a shed request may have a blockless descriptor left by a
                 # failed capacity probe — drop it, or the uid could never
-                # be reused
+                # be reused (ditto a stale swap-tier record)
                 self.state.flush_sequence(shed.uid)
                 self._ledger.pop(shed.uid, None)
+                self._drop_swap(shed.uid)
             tel.gauges["slo_risk"] = round(sched.risk, 4)
             # ---- frame-boundary preemption: make room for a queued
             # interactive arrival by evicting a lower-priority live row ----
@@ -1419,7 +1719,7 @@ class InferenceEngineV2:
                              for u, s in slots.slot_of_uid.items()}
                 for uid in sched.pick_victims(
                         committed, free_blocks=self.kv.free_blocks):
-                    self._evict_to_queue(uid, slots, sched)
+                    self._evict_to_queue(uid, slots, sched, boundary)
             # ---- policy admission (strict priority + fair share) ----
             blocks_before = self.kv.free_blocks
             alloc_blocked = faults is not None \
@@ -1432,19 +1732,21 @@ class InferenceEngineV2:
 
             def try_reserve(req):
                 seq = self.state.get_or_create_sequence(req.uid)
-                if not self.state.ensure_capacity(
-                        seq, len(req.tokens) + req.limit + 1):
+                cached0 = self._admit_capacity(req.uid, seq, req.tokens,
+                                               req.limit, boundary)
+                if cached0 is None:
                     return None
-                return seq
+                return (seq, cached0)
 
             admits = []
             if not alloc_blocked:
-                for req, seq in sched.pick(slots.free_slots(), try_reserve,
+                for req, res in sched.pick(slots.free_slots(), try_reserve,
                                            live_count=slots.live_count()):
+                    seq, cached0 = res
                     seq.done = False
                     req.gen_base = len(seq.generated)
                     admits.append((req.uid, seq, req.tokens, req.limit,
-                                   req.temp, req.eos))
+                                   req.temp, req.eos, cached0))
                     tel.on_admit(req.uid)
             if sched.queued_count():
                 tel.on_defer(
@@ -1495,6 +1797,7 @@ class InferenceEngineV2:
                 seq.seen_tokens = int(
                     slots.committed_h[slots.slot_of_uid[uid]])
                 tel.on_emit(uid, len(new_toks))
+            self._publish_prefixes(slots)
             for uid in finished:
                 seq = self.state.seqs[uid]
                 seq.done = True
@@ -1503,6 +1806,7 @@ class InferenceEngineV2:
                 self.state.flush_sequence(uid)
                 sched.on_retire(uid)
                 self._ledger.pop(uid, None)
+                self._drop_swap(uid)
                 tel.on_retire(uid)
                 yield uid, out
 

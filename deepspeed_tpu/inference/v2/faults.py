@@ -62,7 +62,10 @@ import time
 from typing import Dict, List, Optional
 
 FAULT_KINDS = ("poison_row", "deadline_expired", "dispatch_failed",
-               "dispatch_retry", "slow_frame", "kv_alloc_failed")
+               "dispatch_retry", "slow_frame", "kv_alloc_failed",
+               # a KV swap-tier page restore/spill failed; the engine falls
+               # back to re-prefill (correctness preserved, work recomputed)
+               "swap_failed")
 
 INJECTABLE_KINDS = ("dispatch_exception", "kv_alloc_fail", "poison_row",
                     "slow_frame")
@@ -224,7 +227,7 @@ class FaultInjector:
 
 
 def snapshot_ledger(ledger: Dict[int, LedgerEntry], seqs: Dict,
-                    clock) -> Dict:
+                    clock, swap_tier=None) -> Dict:
     """Serialize the host-side request ledger to a plain-python snapshot
     (JSON-serializable ints/lists only — safe to persist across processes).
 
@@ -233,13 +236,25 @@ def snapshot_ledger(ledger: Dict[int, LedgerEntry], seqs: Dict,
     simply re-generated by the resume's re-prefill, greedy-identically),
     the remaining deadline budget, and the scheduling metadata. Zero device
     reads: everything here is host state the serve loops already maintain.
+
+    ``swap_tier`` (a ``kv_hierarchy.KVSwapTier``) annotates requests whose
+    committed pages are ALREADY in the host-RAM tier (preemption victims):
+    ``swapped_tokens`` records the watermark those pages cover, and a
+    resume on an engine sharing the tier directory restores the pages
+    instead of re-prefilling them. Purely informational in the snapshot —
+    the resume admission consults the tier itself by uid.
     """
     now = clock()
     reqs = []
     for uid, ent in ledger.items():
         seq = seqs.get(uid)
         generated = [int(t) for t in seq.generated] if seq is not None else []
+        swapped = None
+        if swap_tier is not None:
+            rec = swap_tier.request_record(uid)
+            swapped = rec["tokens"] if rec else None
         reqs.append({
+            "swapped_tokens": swapped,
             "uid": int(uid),
             "prompt": [int(t) for t in ent.prompt],
             "generated": generated,

@@ -11,12 +11,17 @@ concatenation of its blocks; prefill chunks gather pages by block table (XLA
 gather), decode attends in place.
 """
 
+import functools
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .blocked_allocator import BlockedAllocator
+
+# process-wide compiled page-movement helpers (see BlockedKVCache._fn)
+_PAGE_FNS = {}
 
 
 class BlockedKVCache:
@@ -31,6 +36,7 @@ class BlockedKVCache:
         self.k = jnp.zeros(shape, dtype)
         self.v = jnp.zeros(shape, dtype)
         self.allocator = BlockedAllocator(num_blocks)
+        self._sharding = None       # set by shard(); places swap-in updates
 
     def blocks_for(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
@@ -44,6 +50,7 @@ class BlockedKVCache:
         on every shard — admission control stays topology-blind."""
         self.k = jax.device_put(self.k, sharding)
         self.v = jax.device_put(self.v, sharding)
+        self._sharding = sharding
 
     def reserve_trash_block(self) -> None:
         """Pin block 0 as the trash block: padded/frozen rows' writes (and
@@ -90,6 +97,104 @@ class BlockedKVCache:
         off = pos % self.block_size                    # (S,) offset in block
         self.k = self.k.at[:, :, blk, off].set(new_k.transpose(0, 2, 1, 3))
         self.v = self.v.at[:, :, blk, off].set(new_v.transpose(0, 2, 1, 3))
+
+    # ------------------------------------------------------------------
+    # page movement (KV memory hierarchy: COW copies + host-RAM swap tier)
+    #
+    # All three helpers are frame-BOUNDARY device ops: the prefix cache's
+    # copy-on-write block copy, and the swap tier's page read/restore. They
+    # are jitted (the pool-donating ones in-place) and registered in
+    # ``analysis/programs.py`` so graft-lint GL001/GL002/GL004 cover them
+    # like the frame loops; block-id operands are padded to power-of-two
+    # buckets (pad id 0 = the trash block) so the jit cache stays O(log).
+    # Call sites must REBIND the donated pools from the result tuple —
+    # ``kv.k, kv.v = kv.copy_blocks(kv.k, kv.v, src, dst)`` — the GL002
+    # AST cross-check enforces it (ast_checks.DISPATCH_DONATIONS).
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _build_copy_blocks():
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def copy_blocks(kpool, vpool, src, dst):
+            """Copy whole pages src[i] -> dst[i] inside the (donated)
+            pools — the COW block copy. Pad pairs are (0, 0): the trash
+            block copied onto itself."""
+            return (kpool.at[:, :, dst].set(kpool[:, :, src]),
+                    vpool.at[:, :, dst].set(vpool[:, :, src]))
+        return copy_blocks
+
+    @staticmethod
+    def _build_gather_pages():
+        @jax.jit
+        def gather_pages(kpool, vpool, ids):
+            """Read pages ``ids`` out of the pools as one
+            (L, KVH, n, bs, D) pair (swap-out staging; the caller's
+            ``np.asarray`` is the boundary D2H transfer)."""
+            return jnp.take(kpool, ids, axis=2), jnp.take(vpool, ids, axis=2)
+        return gather_pages
+
+    @staticmethod
+    def _build_scatter_pages():
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def scatter_pages(kpool, vpool, ids, kp, vp):
+            """Write page payloads back into the (donated) pools at
+            ``ids`` (swap-in restore). Pad ids are 0: garbage lands in the
+            trash block, which is never read as live content."""
+            return (kpool.at[:, :, ids].set(kp.astype(kpool.dtype)),
+                    vpool.at[:, :, ids].set(vp.astype(vpool.dtype)))
+        return scatter_pages
+
+    def _pad_ids(self, ids: List[int], pad: int = 0) -> jnp.ndarray:
+        w = self.bucket_width(max(len(ids), 1), self.num_blocks)
+        out = np.full((w,), pad, np.int32)
+        out[:len(ids)] = ids
+        return jnp.asarray(out)
+
+    def _fn(self, name: str):
+        # the page movers are pure functions of their operands (no
+        # closed-over state), so every cache instance shares ONE jit per
+        # helper — a fresh engine reuses the compiled program instead of
+        # paying a recompile inside some request's TTFT
+        if name not in _PAGE_FNS:
+            _PAGE_FNS[name] = getattr(BlockedKVCache, f"_build_{name}")()
+        return _PAGE_FNS[name]
+
+    def copy_blocks(self, kpool, vpool, src_ids: List[int],
+                    dst_ids: List[int]):
+        """COW page copy at a frame boundary; returns the updated (donated)
+        pools — rebind them."""
+        assert len(src_ids) == len(dst_ids)
+        return self._fn("copy_blocks")(kpool, vpool, self._pad_ids(src_ids),
+                                       self._pad_ids(dst_ids))
+
+    def read_pages(self, block_ids: List[int]):
+        """Swap-out read: pages as HOST numpy (L, KVH, n, bs, D) k/v pair.
+        One boundary D2H transfer per pool; under tensor parallelism the
+        pools are head-sharded, so the transfer assembles per-shard slices
+        along axis 1."""
+        kp, vp = self._fn("gather_pages")(self.k, self.v,
+                                          self._pad_ids(block_ids))
+        n = len(block_ids)
+        return np.asarray(kp)[:, :, :n], np.asarray(vp)[:, :, :n]
+
+    def scatter_pages(self, kpool, vpool, block_ids: List[int],
+                      k_pages: np.ndarray, v_pages: np.ndarray):
+        """Swap-in restore: scatter host page payloads into the (donated)
+        pools at ``block_ids``; returns the updated pools — rebind them.
+        Under tensor parallelism the update is placed with the pools'
+        sharding first, so the scatter stays shard-local."""
+        ids = self._pad_ids(block_ids)
+        w = int(ids.shape[0])
+        n = len(block_ids)
+        if w > n:   # pad payload rows to the id bucket (land in trash)
+            reps = [(0, 0)] * 5
+            reps[2] = (0, w - n)
+            k_pages = np.pad(k_pages, reps)
+            v_pages = np.pad(v_pages, reps)
+        if self._sharding is not None:
+            k_pages = jax.device_put(jnp.asarray(k_pages), self._sharding)
+            v_pages = jax.device_put(jnp.asarray(v_pages), self._sharding)
+        return self._fn("scatter_pages")(kpool, vpool, ids, k_pages, v_pages)
 
     def gather(self, block_table: jnp.ndarray):
         """block_table: (B, max_blocks) → (L, B, max_blocks*block_size, KVH, D)

@@ -1,0 +1,541 @@
+"""KV memory hierarchy: prefix cache (tier 0/1) + host-RAM swap tier.
+
+Production traffic is dominated by shared prefixes — system prompts,
+few-shot headers, multi-turn history — yet a paged KV pool alone still
+re-prefills every admission from token zero and throws committed pages away
+on preemption. This module adds the two missing tiers on top of the
+refcounted ``BlockedAllocator`` (README "KV memory hierarchy"):
+
+1. **PrefixCache** — a host-side index of token-block-aligned prefixes over
+   the LIVE device pool. At every frame boundary the engine *publishes* each
+   sequence's full blocks below its committed watermark (the cache takes one
+   allocator reference per published block — content below the watermark is
+   final and immutable, so a published page can be shared read-only).
+   Admission *matches* a new prompt against the chain: hit blocks are mapped
+   straight into the request's block table (``allocator.share``) and prefill
+   starts at the first uncached position — TTFT collapses on shared-prefix
+   schedules. A hit that ends MID-block triggers **copy-on-write**: the
+   divergent request gets a private copy of the boundary page
+   (``BlockedKVCache.copy_blocks``, one frame-boundary device op) and writes
+   its continuation there, so published content is never mutated.
+
+2. **KVSwapTier** — a host-RAM tier on the ``swap_tensor`` machinery
+   (``AsyncTensorSwapper``: atomic, crash-safe commits). Under KV pressure
+   cold unreferenced prefix blocks spill to host instead of being dropped;
+   scheduler preemption swaps the victim's committed pages out and
+   re-admission swaps them back in (replacing the full re-prefill); and
+   because the tier's index is persisted beside the pages, a restarted
+   engine's ``serve(resume_from=)`` restores pages instead of recomputing
+   them. All device touches are frame-boundary-only (the in-frame
+   transfer-guard tests stay green) and topology-blind: block tables carry
+   block IDS, so head-sharded tensor-parallel pools swap logical pages
+   whose payloads assemble from per-shard slices.
+
+Sharing is bitwise-safe: a page below the committed watermark holds KV that
+depends only on the token prefix (causal attention, deterministic forward),
+and the hit granularity is rounded down to the prefill chunk so a cache-hit
+admission replays the exact chunk boundaries a cold prefill would use.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from ...runtime.swap_tensor.swapper import AsyncTensorSwapper
+from ...utils.logging import logger
+
+CHAIN_ROOT = -1          # parent id of depth-0 prefix blocks
+
+
+def token_fingerprint(tokens: Sequence[int]) -> str:
+    """Content fingerprint of a token prefix (sha1 over the int64 bytes).
+    Swap-tier request records carry it so a REUSED uid can never restore
+    another request's pages: the pages are only valid under the exact
+    token prefix they were committed for."""
+    return hashlib.sha1(
+        np.ascontiguousarray(np.asarray(tokens, np.int64)).tobytes()
+    ).hexdigest()
+
+
+@dataclasses.dataclass
+class PrefixEntry:
+    """One published token-block: node ``depth`` of a prefix chain. The
+    cache holds ONE allocator reference on ``block`` while resident;
+    ``block is None`` means the page content lives in the swap tier under
+    ``kvblk_<eid>`` and can be restored into a fresh block on a match."""
+    eid: int
+    parent: int                 # parent entry id, CHAIN_ROOT at depth 0
+    depth: int                  # block index within the prefix chain
+    tokens: Tuple[int, ...]     # the block's token ids (len == block_size)
+    block: Optional[int]        # device block id; None = swapped out
+    source_uid: int             # publisher (quarantine invalidation)
+    last_used: int = 0          # LRU clock stamp
+
+
+class PrefixCache:
+    """Host-side prefix index with copy-on-write block sharing.
+
+    ``max_blocks`` caps how many device blocks the cache may pin
+    (LRU-evicting beyond it); ``swap`` (a ``KVSwapTier``) turns eviction
+    into a spill to host RAM instead of a drop. The cache never owns the
+    pools — it holds allocator references and block ids only."""
+
+    def __init__(self, kv, max_blocks: Optional[int] = None, swap=None):
+        self.kv = kv
+        self.bs = kv.block_size
+        self.max_blocks = max_blocks
+        self.swap = swap
+        # set by the engine when a speculative draft is attached: spilled
+        # prefix pages then carry the draft pool's page too, so a restored
+        # block keeps draft acceptance instead of proposing against stale
+        # pages (target-only restore would still be CORRECT — verification
+        # rejects bad proposals — but throughput would silently collapse)
+        self.draft_kv = None
+        self._by_key: Dict[Tuple[int, Tuple[int, ...]], PrefixEntry] = {}
+        self._by_id: Dict[int, PrefixEntry] = {}
+        self._children: Dict[int, Set[int]] = {}
+        self._next_id = 0
+        self._clock = 0
+        self.stats = dict(lookups=0, hits=0, hit_tokens=0, published=0,
+                          cow_copies=0, evicted=0, swapped_out=0,
+                          swapped_in=0)
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self._by_id)
+
+    def resident_blocks(self) -> int:
+        return sum(1 for e in self._by_id.values() if e.block is not None)
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _bkey(self, e: PrefixEntry) -> str:
+        return f"kvblk_{e.eid}"
+
+    # ------------------------------------------------------------------
+    # publish: full blocks below the committed watermark enter the index
+    # ------------------------------------------------------------------
+
+    def publish(self, uid: int, stream: Sequence[int], blocks: List[int],
+                upto_tokens: int, start_depth: int = 0,
+                parent: int = CHAIN_ROOT) -> Tuple[int, int, int]:
+        """Walk the stream's full blocks below ``upto_tokens`` (the
+        committed watermark) and index any not yet published, taking one
+        allocator reference each. ``stream`` starts at token
+        ``start_depth * block_size`` — the caller passes only the
+        unpublished suffix, so a long-context row's boundary publish
+        never copies its whole history. Idempotent: existing entries are
+        kept (first publisher wins — re-publishing the same content under
+        a different physical block would just waste a page).
+
+        ``start_depth``/``parent`` resume an earlier walk (the caller
+        caches the last published chain position per sequence, keeping
+        per-boundary publish cost O(new blocks), not O(stream)); a stale
+        ``parent`` — its entry reclaimed since — restarts from the root.
+        Returns (newly published count, final chain parent eid, depth
+        actually reached) — the caller must advance its publish cursor
+        only to the REACHED depth: an early stop (cache at capacity)
+        otherwise leaves a positional gap the chain would silently paper
+        over, and a later match against the gapped chain could map pages
+        from the wrong absolute position."""
+        if parent != CHAIN_ROOT and parent not in self._by_id:
+            # the cached chain position was reclaimed since the last walk;
+            # the caller's suffix no longer lines up with any live entry —
+            # reset its cursor (the next boundary republishes from the
+            # root with the full stream)
+            return 0, CHAIN_ROOT, 0
+        new = 0
+        d_done = start_depth
+        walked: Set[int] = set() if parent == CHAIN_ROOT else {parent}
+        for d in range(start_depth,
+                       min(upto_tokens // self.bs, len(blocks))):
+            rel = d - start_depth          # stream is the suffix from here
+            toks = tuple(int(t)
+                         for t in stream[rel * self.bs:(rel + 1) * self.bs])
+            key = (parent, toks)
+            e = self._by_key.get(key)
+            if e is None:
+                # protect the walked ancestors: an unprotected reclaim
+                # here could drop this very chain mid-walk and the new
+                # child would attach to a dead parent (an unreachable,
+                # unclearable block reference)
+                if self.max_blocks is not None and \
+                        self.resident_blocks() >= self.max_blocks:
+                    if not self.reclaim(1, protect=walked):
+                        break  # cache full and nothing evictable: stop here
+                    if parent != CHAIN_ROOT and parent not in self._by_id:
+                        # a resumed walk doesn't hold its deep ancestors
+                        # in ``walked``; if the reclaim dropped one, its
+                        # subtree took ``parent`` with it — stop, the
+                        # next publish restarts from the root
+                        break
+                self.kv.allocator.share([blocks[d]])
+                e = PrefixEntry(eid=self._next_id, parent=parent, depth=d,
+                                tokens=toks, block=blocks[d],
+                                source_uid=uid, last_used=self._tick())
+                self._next_id += 1
+                self._by_key[key] = e
+                self._by_id[e.eid] = e
+                self._children.setdefault(parent, set()).add(e.eid)
+                new += 1
+            parent = e.eid
+            walked.add(parent)
+            d_done = d + 1
+        self.stats["published"] += new
+        return new, parent, d_done
+
+    # ------------------------------------------------------------------
+    # match: longest published chain covering a new prompt
+    # ------------------------------------------------------------------
+
+    def match(self, prompt: Sequence[int]
+              ) -> Tuple[List[PrefixEntry], Optional[Tuple[PrefixEntry, int]]]:
+        """Longest full-block chain matching ``prompt`` plus, past it, the
+        best PARTIAL child match ``(entry, m)`` — a published block whose
+        first ``m`` tokens continue the prompt (the copy-on-write source:
+        the caller copies the page and diverges mid-block). Pure lookup:
+        reference counts and LRU stamps move in ``map_hit``."""
+        self.stats["lookups"] += 1
+        out: List[PrefixEntry] = []
+        parent, pos = CHAIN_ROOT, 0
+        prompt = [int(t) for t in prompt]
+        while pos + self.bs <= len(prompt):
+            e = self._by_key.get((parent, tuple(prompt[pos:pos + self.bs])))
+            if e is None:
+                break
+            out.append(e)
+            parent, pos = e.eid, pos + self.bs
+        partial = None
+        rem = prompt[pos:pos + self.bs]
+        if rem:
+            best_m = 0
+            for ceid in self._children.get(parent, ()):
+                ce = self._by_id[ceid]
+                m = 0
+                for a, b in zip(ce.tokens, rem):
+                    if a != b:
+                        break
+                    m += 1
+                if m > best_m:
+                    best_m, partial = m, (ce, m)
+        return out, partial
+
+    def ensure_resident(self, entry: PrefixEntry,
+                        protect: Optional[Set[int]] = None) -> bool:
+        """Swapped-out entries restore into a freshly allocated block
+        (swap tier read + one boundary scatter). False when the entry
+        cannot be made resident (no tier, or the pool is truly full even
+        after reclaiming). ``protect`` must cover every OTHER entry the
+        caller intends to map from this match: until ``map_hit`` shares
+        them they sit at refcount 1 and an unprotected reclaim here could
+        spill a chain-mate the caller already vetted."""
+        if entry.block is not None:
+            return True
+        if self.swap is None:
+            return False
+        alloc = self.kv.allocator
+        protect = (protect or set()) | {entry.eid}
+        if alloc.free_blocks < 1 and not self.reclaim(1, protect=protect):
+            return False
+        block = alloc.allocate(1)[0]
+        try:
+            self.swap.restore_block(self._bkey(entry), self.kv, block,
+                                    draft_kv=self.draft_kv)
+        except Exception as e:       # noqa: BLE001 — degrade to a miss
+            alloc.free([block])
+            logger.warning(f"prefix cache: restore of swapped block "
+                           f"eid={entry.eid} failed ({e}); treating as miss")
+            self._drop_subtree(entry)
+            return False
+        entry.block = block
+        self.stats["swapped_in"] += 1
+        return True
+
+    def touch(self, entries: Sequence[PrefixEntry], hit_tokens: int) -> None:
+        """Stamp a successful hit (LRU + counters)."""
+        now = self._tick()
+        for e in entries:
+            e.last_used = now
+        if hit_tokens > 0:
+            self.stats["hits"] += 1
+            self.stats["hit_tokens"] += hit_tokens
+
+    # ------------------------------------------------------------------
+    # eviction / invalidation
+    # ------------------------------------------------------------------
+
+    def _drop_subtree(self, root: PrefixEntry) -> int:
+        """Remove ``root`` and every descendant from the index (children
+        are unreachable once their parent's chain link is gone): drop the
+        cache's block reference (sharers keep the page alive) or the swap
+        record. Iterative worklist — a 64k-token shared prefix is a
+        >1000-deep linear chain, past Python's recursion limit. Returns
+        how many device blocks actually RETURNED to the free pool
+        (cache-only references)."""
+        n = 0
+        todo = [root]
+        while todo:
+            e = todo.pop()
+            todo.extend(self._by_id[ceid]
+                        for ceid in self._children.get(e.eid, ()))
+            if e.block is not None:
+                if self.kv.allocator.refcount(e.block) == 1:
+                    n += 1
+                self.kv.allocator.free([e.block])
+                e.block = None
+            elif self.swap is not None:
+                self.swap.drop_block(self._bkey(e))
+            self._by_key.pop((e.parent, e.tokens), None)
+            self._by_id.pop(e.eid, None)
+            self._children.pop(e.eid, None)
+            self._children.get(e.parent, set()).discard(e.eid)
+        return n
+
+    def reclaim(self, n_blocks: int, protect: Optional[Set[int]] = None
+                ) -> int:
+        """Free up to ``n_blocks`` device blocks from cold UNREFERENCED
+        entries (allocator refcount 1 — the cache's own reference), LRU
+        first. With a swap tier the page spills to host RAM and the entry
+        stays matchable (restored on the next hit); without one the entry
+        (and its now-unreachable subtree) is dropped. Returns the number
+        of device blocks actually freed."""
+        protect = protect or set()
+        freed = 0
+        cands = sorted((e for e in self._by_id.values()
+                        if e.block is not None and e.eid not in protect
+                        and self.kv.allocator.refcount(e.block) == 1),
+                       key=lambda e: e.last_used)
+        for e in cands:
+            if freed >= n_blocks:
+                break
+            if e.eid not in self._by_id or e.block is None:
+                continue       # dropped/spilled as part of an earlier subtree
+            if self.swap is not None:
+                try:
+                    self.swap.put_block(self._bkey(e), self.kv, e.block,
+                                        draft_kv=self.draft_kv)
+                except Exception as err:   # noqa: BLE001 — drop instead
+                    logger.warning(f"prefix cache: spill of block "
+                                   f"eid={e.eid} failed ({err}); dropping")
+                    freed += self._drop_subtree(e)
+                    self.stats["evicted"] += 1
+                    continue
+                self.kv.allocator.free([e.block])
+                e.block = None
+                freed += 1
+                self.stats["swapped_out"] += 1
+            else:
+                freed += self._drop_subtree(e)
+            self.stats["evicted"] += 1
+        return freed
+
+    def invalidate_uid(self, uid: int) -> int:
+        """Drop every entry published by ``uid`` (and its subtrees) — the
+        quarantine hook: a row whose logits went non-finite may have
+        written non-finite KV, and a poisoned page must never be handed
+        to a healthy request."""
+        doomed = [e for e in self._by_id.values() if e.source_uid == uid]
+        n0 = len(self._by_id)
+        for e in doomed:
+            if e.eid in self._by_id:       # not already dropped via a parent
+                self._drop_subtree(e)
+        return n0 - len(self._by_id)
+
+    def clear(self) -> None:
+        """Release every cache-held reference (tests / explicit flush)."""
+        for e in [e for e in self._by_id.values() if e.parent == CHAIN_ROOT]:
+            self._drop_subtree(e)
+
+
+class KVSwapTier:
+    """Host-RAM tier for committed KV pages, on the ``swap_tensor``
+    machinery. Two record kinds share one ``AsyncTensorSwapper``
+    (atomic, crash-safe `.swp` commits) plus a tiny JSON index persisted
+    beside the pages, so a tier directory outlives the engine process —
+    ``serve(resume_from=)`` on a fresh engine restores a preempted
+    victim's pages instead of re-prefilling them:
+
+    * **request records** (``kvreq_<uid>_*``) — a preempted/crashed
+      request's committed pages (target k/v and, under speculation, the
+      draft pools' pages for the same block ids);
+    * **block records** (``kvblk_<eid>_*``) — single cold prefix-cache
+      pages spilled under KV pressure.
+    """
+
+    def __init__(self, swap_dir: str, aio_handle=None):
+        self.swapper = AsyncTensorSwapper(swap_dir, aio_handle)
+        self._index_path = os.path.join(swap_dir, "kv_tier_index.json")
+        self._index = {"requests": {}, "blocks": {}}
+        if os.path.exists(self._index_path):
+            try:
+                with open(self._index_path) as f:
+                    self._index = json.load(f)
+            except (OSError, ValueError):
+                logger.warning(f"KVSwapTier: unreadable index at "
+                               f"{self._index_path}; starting empty")
+        self.stats = dict(requests_out=0, requests_in=0, blocks_out=0,
+                          blocks_in=0)
+        # spilled prefix-BLOCK records reference in-memory entry ids, so
+        # anything left by a previous process is unreachable by
+        # construction — drop it now or a tmpfs tier leaks host RAM on
+        # every crash/restart cycle. (Request records stay: they are the
+        # crash-recovery payload; serve() prunes the non-resumed ones.)
+        # One tier directory belongs to one engine at a time.
+        for key in list(self._index["blocks"]):
+            self.drop_block(key)
+
+    def _save_index(self) -> None:
+        tmp = self._index_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(self._index, f)
+        os.replace(tmp, self._index_path)
+
+    @staticmethod
+    def _page_shape(kv, n: int) -> Tuple[int, ...]:
+        return (kv.num_layers, kv.kv_heads, n, kv.block_size, kv.head_dim)
+
+    def _adopt(self, key: str, kv, n: int) -> None:
+        """Register swapper metadata for a key written by a previous tier
+        instance (crash recovery: the files survive, the in-memory swapper
+        state does not)."""
+        self.swapper.adopt(key, self._page_shape(kv, n),
+                           np.dtype(str(kv.k.dtype)))
+
+    def _put(self, prefix: str, kv, blocks: List[int], draft_kv=None
+             ) -> Dict:
+        kp, vp = kv.read_pages(blocks)
+        self.swapper.swap_out(f"{prefix}_k", kp, async_op=True)
+        self.swapper.swap_out(f"{prefix}_v", vp, async_op=True)
+        if draft_kv is not None:
+            dkp, dvp = draft_kv.read_pages(blocks)
+            self.swapper.swap_out(f"{prefix}_dk", dkp, async_op=True)
+            self.swapper.swap_out(f"{prefix}_dv", dvp, async_op=True)
+        self.swapper.wait()      # atomic commit; raises (and rolls back)
+        rec = {"blocks": len(blocks), "draft": draft_kv is not None,
+               "dtype": str(kv.k.dtype),
+               "page_shape": list(self._page_shape(kv, len(blocks)))}
+        if draft_kv is not None:
+            rec["draft_shape"] = list(self._page_shape(draft_kv,
+                                                       len(blocks)))
+        return rec
+
+    def _restore(self, prefix: str, rec: Dict, kv, dst_blocks: List[int],
+                 draft_kv=None) -> None:
+        if rec["dtype"] != str(kv.k.dtype):
+            raise IOError(f"{prefix}: pages were swapped as {rec['dtype']} "
+                          f"but the pool is {kv.k.dtype}")
+        n = rec["blocks"]
+        if len(dst_blocks) != n:
+            raise IOError(f"{prefix}: {n} pages recorded, "
+                          f"{len(dst_blocks)} destination blocks")
+        # geometry must match too: a same-dtype engine with a different
+        # block size / layer count would otherwise SHORT-READ the old
+        # file without an aio error and scatter misaligned payloads —
+        # silent KV corruption instead of the loud swap_failed fallback
+        if tuple(rec.get("page_shape", ())) != self._page_shape(kv, n):
+            raise IOError(
+                f"{prefix}: pages were swapped with geometry "
+                f"{rec.get('page_shape')} but the pool expects "
+                f"{self._page_shape(kv, n)}")
+        if rec.get("draft") and draft_kv is not None and \
+                tuple(rec.get("draft_shape", ())) != \
+                self._page_shape(draft_kv, n):
+            raise IOError(f"{prefix}: draft page geometry mismatch")
+        self._adopt(f"{prefix}_k", kv, n)
+        self._adopt(f"{prefix}_v", kv, n)
+        kp = self.swapper.swap_in(f"{prefix}_k")
+        vp = self.swapper.swap_in(f"{prefix}_v")
+        kv.k, kv.v = kv.scatter_pages(kv.k, kv.v, dst_blocks, kp, vp)
+        if rec.get("draft") and draft_kv is not None:
+            self._adopt(f"{prefix}_dk", draft_kv, n)
+            self._adopt(f"{prefix}_dv", draft_kv, n)
+            dkp = self.swapper.swap_in(f"{prefix}_dk")
+            dvp = self.swapper.swap_in(f"{prefix}_dv")
+            draft_kv.k, draft_kv.v = draft_kv.scatter_pages(
+                draft_kv.k, draft_kv.v, dst_blocks, dkp, dvp)
+
+    def _drop(self, prefix: str, rec: Dict) -> None:
+        for suffix in ("_k", "_v") + (("_dk", "_dv") if rec.get("draft")
+                                      else ()):
+            self.swapper.release(prefix + suffix)
+
+    # ---------------- request records (preemption / crash recovery) ----
+
+    def put_request(self, uid: int, tokens: int, kv, blocks: List[int],
+                    draft_kv=None, fingerprint: Optional[str] = None
+                    ) -> None:
+        """Swap a victim's committed pages out. ``tokens`` is the committed
+        watermark the pages cover and ``fingerprint`` the
+        ``token_fingerprint`` of exactly those tokens — restore validates
+        both, so a stale record (or a reused uid) can never restore pages
+        under different content."""
+        rec = self._put(f"kvreq_{uid}", kv, blocks, draft_kv)
+        rec["tokens"] = int(tokens)
+        rec["fingerprint"] = fingerprint
+        self._index["requests"][str(uid)] = rec
+        self._save_index()
+        self.stats["requests_out"] += 1
+
+    def request_record(self, uid: int) -> Optional[Dict]:
+        return self._index["requests"].get(str(uid))
+
+    def restore_request(self, uid: int, kv, dst_blocks: List[int],
+                        draft_kv=None) -> None:
+        rec = self._index["requests"][str(uid)]
+        self._restore(f"kvreq_{uid}", rec, kv, dst_blocks, draft_kv)
+        self.stats["requests_in"] += 1
+
+    def drop_request(self, uid: int) -> None:
+        rec = self._index["requests"].pop(str(uid), None)
+        if rec is None:
+            return
+        self._drop(f"kvreq_{uid}", rec)
+        self._save_index()
+
+    def prune_requests(self, keep_uids) -> int:
+        """Drop request records for uids NOT in ``keep_uids`` (serve()
+        start: records exist solely for swap-in re-admission, so a new
+        run that will not resume a uid has abandoned its pages — without
+        this, every crashed-and-not-resumed request leaks its pages in
+        the tier forever)."""
+        doomed = [u for u in list(self._index["requests"])
+                  if int(u) not in keep_uids]
+        for u in doomed:
+            self.drop_request(int(u))
+        return len(doomed)
+
+    # ---------------- block records (prefix-cache spill) ----------------
+
+    def put_block(self, key: str, kv, block: int, draft_kv=None) -> None:
+        self._index["blocks"][key] = self._put(key, kv, [block],
+                                               draft_kv=draft_kv)
+        self._save_index()
+        self.stats["blocks_out"] += 1
+
+    def restore_block(self, key: str, kv, dst_block: int,
+                      draft_kv=None) -> None:
+        # pop the record only AFTER a successful restore: a failed read
+        # must leave it in place so the caller's drop_block can still
+        # release the page files (popping first would leak them)
+        rec = self._index["blocks"][str(key)]
+        self._restore(key, rec, kv, [dst_block], draft_kv=draft_kv)
+        self._index["blocks"].pop(str(key), None)
+        self._drop(key, rec)
+        self._save_index()
+        self.stats["blocks_in"] += 1
+
+    def drop_block(self, key: str) -> None:
+        rec = self._index["blocks"].pop(str(key), None)
+        if rec is None:
+            return
+        self._drop(key, rec)
+        self._save_index()

@@ -27,6 +27,20 @@ class DSSequenceDescriptor:
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     slot: int = -1                  # decode-slot index, -1 = not resident
+    # KV memory hierarchy (kv_hierarchy.py): tokens whose pages are already
+    # valid at admission (mapped prefix-cache blocks, or swapped-in pages) —
+    # prefill starts here instead of token zero. Reset when the blocks are
+    # released (preemption eviction).
+    resume_cached: int = 0
+    # the prefix cache is probed ONCE per enqueue (a capacity-deferred miss
+    # stays a miss across retries — re-probing every boundary would let a
+    # 50-boundary deferral record 50 lookups and skew the hit rate)
+    hier_probed: bool = False
+    # committed-stream position this sequence has published prefix blocks
+    # up to, and the chain entry id at that position (monotonic; the
+    # publish walk resumes there instead of re-hashing from token zero)
+    published_upto: int = 0
+    publish_parent: int = -1        # kv_hierarchy.CHAIN_ROOT
 
     @property
     def in_prefill(self) -> bool:
@@ -228,21 +242,29 @@ class DeviceSlotTable:
 
     def admit(self, items: List[Tuple]) -> None:
         """Admit arrivals into free slots: ``items`` is a list of
-        (uid, seq, prompt_tokens, limit, temperature, eos_id). All device
-        writes are batched — one ``.at[rows].set`` per array, regardless of
-        how many sequences arrive at this frame boundary."""
+        (uid, seq, prompt_tokens, limit, temperature, eos_id[, cached0]).
+        ``cached0`` (default 0) is the KV-hierarchy admission watermark:
+        tokens whose pages are already valid in the row's block table
+        (mapped prefix-cache blocks or swapped-in pages) — the frame body
+        starts prefill there, exactly like resuming a mid-prefill row.
+        All device writes are batched — one ``.at[rows].set`` per array,
+        regardless of how many sequences arrive at this frame boundary."""
         free = [i for i in range(self.n_slots) if self.uid_of_slot[i] < 0]
         assert len(items) <= len(free), "admit() beyond free slots"
         p_w = int(self.prompts.shape[1])
         t_w = int(self.tables.shape[1])
         rows, p_rows, t_rows = [], [], []
-        plens, lims, eoss, temps = [], [], [], []
-        for (uid, seq, toks, limit, temp, eos), slot in zip(items, free):
+        plens, lims, eoss, temps, cacheds = [], [], [], [], []
+        for item, slot in zip(items, free):
+            (uid, seq, toks, limit, temp, eos), rest = item[:6], item[6:]
+            cached0 = int(rest[0]) if rest else 0
             toks = np.asarray(toks, np.int32).reshape(-1)
+            assert 0 <= cached0 < max(len(toks), 1), \
+                "admission watermark must leave >= 1 token to prefill"
             self.uid_of_slot[slot] = uid
             self.slot_of_uid[uid] = slot
             seq.slot = slot
-            self.cached_h[slot] = 0
+            self.cached_h[slot] = cached0
             self.plen_h[slot] = len(toks)
             self.produced_h[slot] = 0
             self.limit_h[slot] = limit
@@ -260,6 +282,7 @@ class DeviceSlotTable:
             lims.append(limit)
             eoss.append(-1 if eos is None else eos)
             temps.append(temp)
+            cacheds.append(cached0)
         # _dev places every staged operand replicated under tp, so each
         # scatter below is one logical mesh-wide update (XLA keeps the
         # result replicated), not a per-shard host loop
@@ -277,7 +300,8 @@ class DeviceSlotTable:
         self.temps = self.temps.at[idx].set(
             self._dev(jnp.asarray(temps, jnp.float32)))
         zero = self._dev(jnp.zeros((len(rows),), jnp.int32))
-        self.cached = self.cached.at[idx].set(zero)
+        self.cached = self.cached.at[idx].set(
+            self._dev(jnp.asarray(cacheds, jnp.int32)))
         self.produced = self.produced.at[idx].set(zero)
         self.last_tok = self.last_tok.at[idx].set(zero)
         self.penult = self.penult.at[idx].set(zero)

@@ -261,11 +261,19 @@ class RequestScheduler:
         floor = min(others) if others else self._vclock
         self._vtime[tenant] = max(self._vtime.get(tenant, 0.0), floor)
 
-    def submit(self, req: Request) -> Optional[ShedReason]:
+    def submit(self, req: Request,
+               bypass_quota: bool = False) -> Optional[ShedReason]:
         """Enqueue an arrival; returns a ``ShedReason`` (and does NOT
-        enqueue) when the tenant's queue quota rejects it."""
+        enqueue) when the tenant's queue quota rejects it.
+
+        ``bypass_quota`` is the crash-recovery resume path: a resumed
+        request was already ACCEPTED by the crashed run (tokens may have
+        been emitted and committed), so ``tenant_max_queued`` — an
+        admission-time back-pressure knob — must not shed it on re-entry
+        and silently drop the committed work (the ``requeue_front``
+        precedent: preempted mid-flight work never re-faces the quota)."""
         cfg = self.cfg
-        if cfg.tenant_max_queued is not None:
+        if not bypass_quota and cfg.tenant_max_queued is not None:
             depth = sum(len(q) for (c, t), q in self._queues.items()
                         if t == req.tenant)
             if depth >= cfg.tenant_max_queued:

@@ -238,7 +238,20 @@ class ServingTelemetry:
                              # faults (kind-labeled), plus the per-kind
                              # headline counters the SLO dashboard plots
                              faults=0, quarantined=0, deadline_expired=0,
-                             recoveries=0, frame_retries=0, slow_frames=0)
+                             recoveries=0, frame_retries=0, slow_frames=0,
+                             # KV memory hierarchy (kv_hierarchy.py):
+                             # prefix-cache hit/publish/COW traffic and
+                             # swap-tier page movement, exported as the
+                             # ds_serving_prefix_* / ds_serving_kv_swap_*
+                             # metric families
+                             prefix_lookups=0, prefix_hits=0,
+                             prefix_hit_tokens=0, prefix_blocks_published=0,
+                             prefix_cow_copies=0, prefix_blocks_evicted=0,
+                             prefix_blocks_swapped_out=0,
+                             prefix_blocks_swapped_in=0,
+                             kv_swap_out_requests=0, kv_swap_out_blocks=0,
+                             kv_swap_in_requests=0, kv_swap_in_blocks=0,
+                             kv_swap_resume_restores=0)
         self.gauges: Dict[str, float] = {
             "live_slots": 0, "slot_count": 0, "queue_depth": 0,
             "kv_blocks_in_use": 0, "kv_blocks_in_use_peak": 0,
@@ -246,6 +259,7 @@ class ServingTelemetry:
             "occupancy": 0.0, "recompiled_programs": 0,
             "slo_risk": 0.0, "frame_steps_chosen": 0,
             "last_recovery_ms": 0.0, "tp_degree": 1,
+            "prefix_blocks_resident": 0, "prefix_hit_rate": 0.0,
         }
         self.hists: Dict[str, LogBucketHistogram] = {
             n: LogBucketHistogram() for n in self.HIST_NAMES}
@@ -442,6 +456,58 @@ class ServingTelemetry:
         re-admission (the window clients waited on the restarted engine)."""
         self.counters["recoveries"] += n_requests
         self.gauges["last_recovery_ms"] = round(recovery_ms, 3)
+
+    # ------------------------------------------------------------------
+    # KV memory hierarchy (prefix cache + swap tier) — perf counters,
+    # gated on ``enabled`` like the frame counters (unlike shed/fault
+    # events, a missed hit count is not a client-visible failure)
+    # ------------------------------------------------------------------
+
+    def on_prefix_lookup(self, hit_tokens: int, hit_blocks: int,
+                         cow: bool) -> None:
+        """One admission-time prefix-cache lookup; ``hit_tokens == 0`` is
+        a miss. ``cow`` marks a mid-block hit that took a copy-on-write
+        page copy."""
+        if not self.enabled:
+            return
+        self.counters["prefix_lookups"] += 1
+        if hit_tokens > 0:
+            self.counters["prefix_hits"] += 1
+            self.counters["prefix_hit_tokens"] += hit_tokens
+        if cow:
+            self.counters["prefix_cow_copies"] += 1
+        self.gauges["prefix_hit_rate"] = round(
+            self.counters["prefix_hits"]
+            / max(1, self.counters["prefix_lookups"]), 4)
+
+    def on_prefix_update(self, published: int, evicted: int,
+                         swapped_out: int, swapped_in: int,
+                         resident: int) -> None:
+        """Frame-boundary prefix-cache bookkeeping delta."""
+        if not self.enabled:
+            return
+        self.counters["prefix_blocks_published"] += published
+        self.counters["prefix_blocks_evicted"] += evicted
+        self.counters["prefix_blocks_swapped_out"] += swapped_out
+        self.counters["prefix_blocks_swapped_in"] += swapped_in
+        self.gauges["prefix_blocks_resident"] = resident
+
+    def on_kv_swap_out(self, n_blocks: int) -> None:
+        """A preemption victim's committed pages left for the host tier."""
+        if not self.enabled:
+            return
+        self.counters["kv_swap_out_requests"] += 1
+        self.counters["kv_swap_out_blocks"] += n_blocks
+
+    def on_kv_swap_in(self, n_blocks: int, resume: bool = False) -> None:
+        """A request re-admitted by restoring its swapped pages (instead
+        of re-prefilling); ``resume`` marks the crash-recovery path."""
+        if not self.enabled:
+            return
+        self.counters["kv_swap_in_requests"] += 1
+        self.counters["kv_swap_in_blocks"] += n_blocks
+        if resume:
+            self.counters["kv_swap_resume_restores"] += 1
 
     def slo_view(self) -> Dict[str, Optional[float]]:
         """LIVE SLO signal: p90 (ms) over the recent sample windows — the

@@ -102,14 +102,38 @@ class AsyncTensorSwapper:
             os.replace(tmp, self._path(key))
         return errs
 
+    def adopt(self, key: str, shape, dtype) -> None:
+        """Register metadata for a key whose committed ``.swp`` file was
+        written by ANOTHER swapper instance (e.g. the process that died
+        before a crash-recovery resume). The caller supplies the shape and
+        dtype it expects; ``swap_in`` then reads the adopted file like any
+        other key. No-op when the key is already tracked."""
+        if key in self._meta:
+            return
+        if not os.path.exists(self._path(key)):
+            raise FileNotFoundError(
+                f"adopt({key}): no committed {self._path(key)}")
+        self._meta[key] = (tuple(shape), np.dtype(dtype))
+
     def release(self, key: str):
-        self._meta.pop(key, None)
-        pend = self._pending.pop(key, None)
-        for path in ([pend[0]] if pend else []) + [self._path(key)]:
-            try:
-                os.remove(path)
-            except OSError:
-                pass
+        """Delete a key's committed file and metadata. Drains the aio
+        queue FIRST when the key (or any sibling) has an un-waited async
+        ``swap_out``: deleting eagerly would let the still-queued aio
+        write recreate the just-removed ``.swp.tmp`` after the fact — a
+        stranded staging file a later error-free ``wait`` could then
+        rename over nothing. A drain error (the writes rolled back) still
+        releases the key before re-raising."""
+        try:
+            if self._pending:
+                self.wait()
+        finally:
+            self._meta.pop(key, None)
+            pend = self._pending.pop(key, None)
+            for path in ([pend[0]] if pend else []) + [self._path(key)]:
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
 
 
 class OptimizerSwapper:

@@ -1,0 +1,497 @@
+"""KV memory hierarchy suite: prefix cache + copy-on-write + host-RAM swap.
+
+Pins the ISSUE-8 acceptance contract:
+
+* greedy outputs are TOKEN-IDENTICAL cache-on vs cache-off — on the FIFO
+  path, with mid-stream arrivals hitting a still-live donor's published
+  blocks, and with a speculative self-draft sharing the target's block
+  tables;
+* copy-on-write isolates divergent continuations: a request that extends a
+  published prefix mid-block writes a private page copy, and a later exact
+  replay of the donor's stream still matches clean content;
+* reference counts balance: after retirement + eviction + quarantine the
+  only blocks in use are the cache's own (and ``clear()`` returns the pool
+  to trash-block-only);
+* scheduler preemption with the swap tier swaps committed pages out and
+  back in, token-identical to the re-prefill path; crash recovery
+  (``serve(resume_from=)`` on a FRESH engine sharing the tier directory)
+  restores pages instead of recomputing;
+* none of it adds a device→host transfer inside a frame (the shared
+  ``frame_transfer_guard`` fixture wraps ``dispatch_frame``);
+* under KV pressure cold prefix blocks spill to the tier and restore on a
+  later hit;
+* a tp=8 sharded engine (virtual CPU mesh) keeps cache-on/cache-off parity
+  (``multichip`` marker).
+
+Engines are built per scenario but share shapes, so the frame jit cache
+stays within the sanitize retrace budget.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2.blocked_allocator import BlockedAllocator
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.faults import (FaultInjector,
+                                               FrameDispatchError)
+from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.kv_hierarchy import KVSwapTier, PrefixCache
+from deepspeed_tpu.inference.v2.scheduler import (RequestScheduler,
+                                                  SchedulerConfig)
+from deepspeed_tpu.models import build_model
+
+BS, CHUNK = 16, 8          # block > chunk: mid-block COW hits are reachable
+
+
+@pytest.fixture(scope="module")
+def tiny_model_params():
+    model = build_model("tiny")
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **over):
+    kw = dict(kv_block_size=BS, prefill_chunk_size=CHUNK,
+              max_tokens_per_step=256, dtype="float32",
+              max_ragged_batch_size=4, frame_steps=2,
+              frame_retry_backoff_s=0.0)
+    kw.update(over)
+    e = InferenceEngineV2(model, RaggedInferenceEngineConfig(**kw),
+                          max_seq_len=160)
+    e.params = jax.device_put(params)
+    return e
+
+
+RNG = np.random.default_rng(7)
+SHARED = RNG.integers(0, 200, (40,)).astype(np.int32)     # 2.5 blocks
+TAILS = {u: RNG.integers(0, 200, (6,)).astype(np.int32) for u in range(8)}
+
+
+def _shared_arrivals(n=6, per_boundary=1):
+    """One arrival per boundary, all sharing SHARED + a unique tail — later
+    arrivals land while earlier donors are still live (publish-at-boundary,
+    not publish-at-retire)."""
+    u = 0
+    while u < n:
+        batch = []
+        for _ in range(per_boundary):
+            if u < n:
+                batch.append((u, np.concatenate([SHARED, TAILS[u]])))
+                u += 1
+        yield batch
+
+
+def _clean(e):
+    """Pool accounting: live blocks == cache-held blocks (+ trash), and a
+    cache clear returns the pool to trash-only."""
+    resident = e.prefix_cache.resident_blocks() if e.prefix_cache else 0
+    assert e.kv.num_blocks - e.kv.free_blocks == resident + 1
+    assert not e.state.seqs
+    if e.prefix_cache is not None:
+        e.prefix_cache.clear()
+        assert e.kv.free_blocks == e.kv.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# allocator + tier units (no model)
+# ---------------------------------------------------------------------------
+
+
+def test_refcounted_allocator_units():
+    a = BlockedAllocator(4)
+    b = a.allocate(2)
+    assert a.free_blocks == 2 and all(a.refcount(x) == 1 for x in b)
+    a.share([b[0]])
+    assert a.refcount(b[0]) == 2
+    a.free(b)                      # drops one ref each; b[0] stays alive
+    assert a.free_blocks == 3 and a.refcount(b[0]) == 1
+    a.free([b[0]])
+    assert a.free_blocks == 4
+    with pytest.raises(RuntimeError, match="double-free"):
+        a.free([b[0]])
+    with pytest.raises(RuntimeError, match="share\\(\\) of free"):
+        a.share([b[1]])
+
+
+def _tiny_pool():
+    kv = BlockedKVCache(num_layers=2, kv_heads=2, head_dim=4, num_blocks=8,
+                        block_size=4, dtype=jnp.float32)
+    kv.reserve_trash_block()
+    return kv
+
+
+def test_swap_tier_roundtrip_across_instances(tmp_path):
+    """Pages committed by one tier instance restore from a FRESH instance
+    on the same directory (the crash-recovery property: the index and the
+    atomic .swp files outlive the process; metadata re-enters the swapper
+    via ``adopt``)."""
+    kv = _tiny_pool()
+    blocks = kv.allocator.allocate(2)
+    payload = np.arange(2 * 2 * 2 * 4 * 4, dtype=np.float32).reshape(
+        2, 2, 2, 4, 4)
+    kv.k = kv.k.at[:, :, blocks].set(payload)
+    kv.v = kv.v.at[:, :, blocks].set(payload * 2)
+    tier = KVSwapTier(str(tmp_path))
+    tier.put_request(7, tokens=8, kv=kv, blocks=blocks)
+    assert tier.request_record(7)["tokens"] == 8
+
+    tier2 = KVSwapTier(str(tmp_path))          # fresh process analog
+    assert tier2.request_record(7)["blocks"] == 2
+    dst = kv.allocator.allocate(2)
+    tier2.restore_request(7, kv, dst)
+    np.testing.assert_array_equal(np.asarray(kv.k[:, :, dst]), payload)
+    np.testing.assert_array_equal(np.asarray(kv.v[:, :, dst]), payload * 2)
+    tier2.drop_request(7)
+    assert tier2.request_record(7) is None
+    assert KVSwapTier(str(tmp_path)).request_record(7) is None
+
+
+def test_prefix_cache_block_spill_and_restore(tmp_path):
+    """A cold unreferenced entry spills its page to the tier (block freed,
+    entry stays matchable) and restores bit-identically on the next hit."""
+    kv = _tiny_pool()
+    tier = KVSwapTier(str(tmp_path))
+    pc = PrefixCache(kv, swap=tier)
+    blocks = kv.allocator.allocate(1)
+    content = np.full((2, 2, 1, 4, 4), 3.5, np.float32)
+    kv.k = kv.k.at[:, :, blocks].set(content)
+    kv.v = kv.v.at[:, :, blocks].set(-content)
+    stream = list(range(4))
+    pc.publish(uid=1, stream=stream, blocks=blocks, upto_tokens=4)
+    kv.allocator.free(blocks)                  # cache ref is now the only one
+    assert pc.reclaim(1) == 1
+    assert pc.resident_blocks() == 0 and kv.allocator.free_blocks == 7
+    full, partial = pc.match(stream + [9])
+    assert len(full) == 1 and full[0].block is None
+    assert pc.ensure_resident(full[0])
+    nb = full[0].block
+    np.testing.assert_array_equal(np.asarray(kv.k[:, :, [nb]]), content)
+    np.testing.assert_array_equal(np.asarray(kv.v[:, :, [nb]]), -content)
+    pc.clear()
+    assert kv.allocator.free_blocks == 7
+
+
+# ---------------------------------------------------------------------------
+# serving parity: prefix cache on vs off
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_hit_token_parity_fifo(tiny_model_params):
+    model, params = tiny_model_params
+    e_off = _engine(model, params)
+    base = dict(e_off.serve(_shared_arrivals(), max_new_tokens=8))
+    e_on = _engine(model, params, prefix_cache=True)
+    outs = dict(e_on.serve(_shared_arrivals(), max_new_tokens=8))
+    assert set(outs) == set(base)
+    for u in base:
+        np.testing.assert_array_equal(base[u], outs[u],
+                                      err_msg=f"uid={u} diverged cache-on")
+    c = e_on.telemetry.counters
+    # mid-stream arrivals hit blocks published by STILL-LIVE donors
+    assert c["prefix_hits"] >= 4
+    assert c["prefix_hit_tokens"] >= 4 * 32
+    assert c["prefix_blocks_published"] > 0
+    # the TTFT lever, measured without a wall clock: cached prefixes are
+    # not re-prefilled, so the cache-on run consumes far fewer prompt
+    # tokens in-frame
+    assert c["prefill_tokens"] < e_off.telemetry.counters["prefill_tokens"]
+    assert e_on.telemetry.gauges["prefix_hit_rate"] >= 0.5
+    _clean(e_on)
+
+
+def test_cow_isolation_under_divergent_continuations(tiny_model_params):
+    """B extends A's stream mid-block (COW copy), C diverges mid-block with
+    different content, then D replays A's exact stream — D must still match
+    the ORIGINAL published pages (COW never mutates shared content)."""
+    model, params = tiny_model_params
+    a_prompt = np.concatenate([SHARED, TAILS[0]])      # 46 tokens
+
+    def mk_arrivals(a_gen):
+        # B: A's prompt + A's first generated tokens (mid-block extension)
+        b = np.concatenate([a_prompt, a_gen[:4]])
+        # C: same length, divergent continuation after SHARED
+        c = np.concatenate([a_prompt, (a_gen[:4] + 1) % 200])
+        # D: exact replay of A's prompt
+        return [[(0, a_prompt)], [], [], [(1, b)], [(2, c)], [], [(3, a_prompt)]]
+
+    e_off = _engine(model, params)
+    a_gen = dict(e_off.serve([[ (0, a_prompt) ]], max_new_tokens=8))[0]
+    base = dict(e_off.serve(mk_arrivals(a_gen), max_new_tokens=8))
+    e_on = _engine(model, params, prefix_cache=True)
+    # warm the cache so B/C/D arrive against published blocks
+    outs = dict(e_on.serve(mk_arrivals(a_gen), max_new_tokens=8))
+    for u in base:
+        np.testing.assert_array_equal(base[u], outs[u],
+                                      err_msg=f"uid={u} diverged under COW")
+    assert e_on.telemetry.counters["prefix_cow_copies"] >= 1
+    _clean(e_on)
+
+
+def test_spec_draft_prefix_parity(tiny_model_params):
+    """Self-draft speculative serving: the draft's paged pools index the
+    target's block tables, so mapped prefix blocks carry draft KV too —
+    greedy outputs stay token-identical cache-on vs cache-off."""
+    model, params = tiny_model_params
+    e_off = _engine(model, params, speculate_gamma=2)
+    e_off.attach_draft(model, params)
+    base = dict(e_off.serve(_shared_arrivals(4), max_new_tokens=12))
+    e_on = _engine(model, params, speculate_gamma=2, prefix_cache=True)
+    e_on.attach_draft(model, params)
+    outs = dict(e_on.serve(_shared_arrivals(4), max_new_tokens=12))
+    for u in base:
+        np.testing.assert_array_equal(base[u], outs[u],
+                                      err_msg=f"uid={u} diverged (spec)")
+    assert e_on.telemetry.counters["prefix_hits"] >= 2
+    _clean(e_on)
+
+
+def test_refcount_accounting_after_retire_evict_quarantine(tiny_model_params):
+    """Retirement + deadline eviction + poison quarantine on a cache-on
+    engine: every non-cache reference unwinds, quarantine invalidates the
+    poisoned row's published entries, and clear() drains the pool."""
+    model, params = tiny_model_params
+    e = _engine(model, params, prefix_cache=True)
+    inj = FaultInjector([{"kind": "poison_row", "frame": 4, "uid": 1}])
+
+    def arrivals():
+        yield [(0, np.concatenate([SHARED, TAILS[0]]))]
+        yield [(1, np.concatenate([SHARED, TAILS[1]]))]
+        yield [{"uid": 2, "tokens": np.concatenate([SHARED, TAILS[2]]),
+                "deadline_ms": 0.0001}]      # expires at the next boundary
+        for _ in range(4):
+            yield []
+
+    outs = dict(e.serve(arrivals(), max_new_tokens=8, faults=inj))
+    assert 0 in outs and 1 not in outs and 2 not in outs
+    kinds = {f.kind for f in e.fault_log}
+    assert {"poison_row", "deadline_expired"} <= kinds
+    # uid 1's published entries were invalidated by the quarantine
+    assert all(ent.source_uid != 1
+               for ent in e.prefix_cache._by_id.values())
+    _clean(e)
+
+
+# ---------------------------------------------------------------------------
+# swap tier: preemption + crash recovery
+# ---------------------------------------------------------------------------
+
+
+PREEMPT_PROMPTS = {u: RNG.integers(0, 200, (24,)).astype(np.int32)
+                   for u in range(3)}
+
+
+def _preempt_arrivals():
+    yield [{"uid": 0, "tokens": PREEMPT_PROMPTS[0], "priority": "best_effort"},
+           {"uid": 1, "tokens": PREEMPT_PROMPTS[1], "priority": "best_effort"}]
+    yield []
+    yield []
+    yield [{"uid": 2, "tokens": PREEMPT_PROMPTS[2],
+            "priority": "interactive"}]
+
+
+def _preempt_run(e):
+    sched = RequestScheduler(SchedulerConfig())
+    outs = dict(e.serve(_preempt_arrivals(), max_new_tokens=16,
+                        frame_slots=2, scheduler=sched))
+    return sched, outs
+
+
+def test_preemption_swap_in_parity(tiny_model_params, tmp_path):
+    """A preempted victim re-admitted via swap-in emits exactly the tokens
+    the re-prefill path emits — and the tier actually carried the pages."""
+    model, params = tiny_model_params
+    e_base = _engine(model, params, max_ragged_batch_size=2)
+    s_base, base = _preempt_run(e_base)
+    assert s_base.summary["preempted"] >= 1      # scenario sanity
+    e_swap = _engine(model, params, max_ragged_batch_size=2,
+                     kv_swap_dir=str(tmp_path))
+    s_swap, outs = _preempt_run(e_swap)
+    assert s_swap.summary["preempted"] >= 1
+    c = e_swap.telemetry.counters
+    assert c["kv_swap_out_requests"] >= 1 and c["kv_swap_in_requests"] >= 1
+    assert c["kv_swap_out_blocks"] == c["kv_swap_in_blocks"] > 0
+    for u in base:
+        np.testing.assert_array_equal(
+            base[u], outs[u], err_msg=f"uid={u} diverged via swap-in")
+    assert e_swap.kv.free_blocks == e_swap.kv.num_blocks - 1
+    assert not e_swap.kv_swap._index["requests"]     # records all consumed
+
+
+def test_resume_restores_pages_parity(tiny_model_params, tmp_path):
+    """Crash AFTER a preemption swapped a victim's pages out: a FRESH
+    engine sharing the tier directory resumes by restoring the pages
+    (kv_swap_resume_restores fires) and the combined outputs match the
+    crash-free baseline token for token."""
+    model, params = tiny_model_params
+    e_base = _engine(model, params, max_ragged_batch_size=2)
+    _, base = _preempt_run(e_base)
+
+    e1 = _engine(model, params, max_ragged_batch_size=2,
+                 kv_swap_dir=str(tmp_path))
+    fatal = FaultInjector([{"kind": "dispatch_exception", "frame": 4,
+                            "times": 100}])
+    got = {}
+    with pytest.raises(FrameDispatchError):
+        for uid, toks in e1.serve(_preempt_arrivals(), max_new_tokens=16,
+                                  frame_slots=2,
+                                  scheduler=RequestScheduler(SchedulerConfig()),
+                                  faults=fatal):
+            got[uid] = toks
+    snap = e1.last_crash_snapshot
+    assert e1.telemetry.counters["kv_swap_out_requests"] >= 1
+    swapped = [r for r in snap["requests"] if r["swapped_tokens"]]
+    assert swapped, "snapshot should surface the swapped victim"
+
+    e2 = _engine(model, params, max_ragged_batch_size=2,
+                 kv_swap_dir=str(tmp_path))
+    got.update(e2.serve(iter([[]]), max_new_tokens=16, frame_slots=2,
+                        scheduler=RequestScheduler(SchedulerConfig()),
+                        resume_from=snap))
+    for u in base:
+        np.testing.assert_array_equal(
+            base[u], got[u], err_msg=f"uid={u} diverged across restart")
+    assert e2.telemetry.counters["kv_swap_resume_restores"] >= 1
+    assert e2.kv.free_blocks == e2.kv.num_blocks - 1
+
+
+def test_stale_swap_record_rejected_on_uid_reuse(tiny_model_params,
+                                                 tmp_path):
+    """A swap record keyed by a reused uid must NOT restore: the content
+    fingerprint mismatches, the record is dropped, and the request cold-
+    prefills to the same tokens as a swap-free engine."""
+    from deepspeed_tpu.inference.v2.kv_hierarchy import token_fingerprint
+    model, params = tiny_model_params
+    p = np.concatenate([SHARED, TAILS[0]])
+    base = dict(_engine(model, params).serve([[(5, p)]], max_new_tokens=8))
+    e = _engine(model, params, kv_swap_dir=str(tmp_path))
+    # plant a stale record for uid 5 under DIFFERENT content
+    junk = RNG.integers(0, 200, (46,)).astype(np.int32)
+    blocks = e.kv.allocator.allocate(2)
+    e.kv_swap.put_request(5, tokens=30, kv=e.kv, blocks=blocks,
+                          fingerprint=token_fingerprint(junk[:30]))
+    e.kv.allocator.free(blocks)
+    outs = dict(e.serve([[(5, p)]], max_new_tokens=8))
+    np.testing.assert_array_equal(base[5], outs[5])
+    assert e.telemetry.counters["kv_swap_in_requests"] == 0
+    assert e.kv_swap.request_record(5) is None      # stale record dropped
+
+
+def test_no_inframe_transfers_with_hierarchy(tiny_model_params, tmp_path,
+                                             frame_transfer_guard):
+    """COW copies, publishes, swap-outs and swap-ins are all frame-BOUNDARY
+    work: the in-frame transfer guard stays green through a schedule that
+    exercises hits, preemption swap, and re-admission."""
+    model, params = tiny_model_params
+    e = _engine(model, params, max_ragged_batch_size=2, prefix_cache=True,
+                kv_swap_dir=str(tmp_path))
+    sched = RequestScheduler(SchedulerConfig())
+    outs = dict(e.serve(_preempt_arrivals(), max_new_tokens=16,
+                        frame_slots=2, scheduler=sched))
+    assert len(outs) == 3
+    e.prefix_cache.clear()
+
+
+def test_spill_under_pressure_then_restore(tiny_model_params, tmp_path):
+    """With a pool too small to hold the cache AND new work, admission
+    reclaims cold prefix blocks by SPILLING them to the tier (not
+    shedding); a later shared-prefix arrival restores the spilled pages
+    and still matches the cache-off outputs."""
+    model, params = tiny_model_params
+    # pool sized so uid 1's reservation forces a spill of uid 0's cache
+    kw = dict(max_ragged_batch_size=1, num_kv_blocks=7,
+              prefix_cache=True, kv_swap_dir=str(tmp_path))
+    a = np.concatenate([SHARED, TAILS[0]])
+    b = RNG.integers(0, 200, (46,)).astype(np.int32)     # no shared prefix
+
+    def arrivals():
+        for u, p in ((0, a), (1, b), (2, a)):
+            yield [(u, p)]
+
+    e_off = _engine(model, params, max_ragged_batch_size=1, num_kv_blocks=7)
+    base = dict(e_off.serve(arrivals(), max_new_tokens=8))
+    e = _engine(model, params, **kw)
+    outs = dict(e.serve(arrivals(), max_new_tokens=8))
+    for u in base:
+        np.testing.assert_array_equal(base[u], outs[u])
+    c = e.telemetry.counters
+    assert c["prefix_blocks_swapped_out"] >= 1
+    assert c["prefix_blocks_swapped_in"] >= 1
+    assert c["prefix_hits"] >= 1
+    _clean(e)
+
+
+def test_deferred_hit_resumes_at_watermark(tiny_model_params):
+    """A prefix-hit admission whose REMAINDER reservation defers must keep
+    its mapped shared blocks AND its admission watermark across the retry:
+    resuming prefill from 0 would write into the published (read-only)
+    pages. Pool sized so the hit request defers behind a live hog, then
+    admits after it retires — outputs must match the cache-off run and the
+    donor's published content must stay clean (a later replay matches)."""
+    model, params = tiny_model_params
+    a = np.concatenate([SHARED, TAILS[0]])               # 46 tokens
+    hog = RNG.integers(0, 200, (46,)).astype(np.int32)   # no shared prefix
+    c = np.concatenate([SHARED, TAILS[1]])
+
+    def arrivals():
+        yield [(0, a, 8)]           # donor: publishes SHARED's blocks
+        yield [(1, hog, 24)]        # hog: holds most of the pool
+        for _ in range(8):
+            yield []
+        yield [(2, c, 24)]          # hit arrives; remainder can't reserve
+        for _ in range(2):
+            yield []
+        yield [(3, a, 8)]           # donor replay: published pages clean
+
+    kw = dict(max_ragged_batch_size=2, num_kv_blocks=10)
+    e_off = _engine(model, params, **kw)
+    base = dict(e_off.serve(arrivals(), max_new_tokens=8))
+    e = _engine(model, params, prefix_cache=True, **kw)
+    outs = dict(e.serve(arrivals(), max_new_tokens=8))
+    for u in base:
+        np.testing.assert_array_equal(base[u], outs[u],
+                                      err_msg=f"uid={u} diverged")
+    tel = e.telemetry.counters
+    assert tel["prefix_hits"] >= 2                # uid 2 and the replay
+    assert tel["admission_deferrals"] >= 1        # uid 2 actually waited
+    _clean(e)
+
+
+def test_prefix_cache_max_blocks_cap(tiny_model_params):
+    model, params = tiny_model_params
+    e = _engine(model, params, prefix_cache=True, prefix_cache_max_blocks=2)
+    outs = dict(e.serve(_shared_arrivals(4), max_new_tokens=8))
+    assert len(outs) == 4
+    assert e.prefix_cache.resident_blocks() <= 2
+    _clean(e)
+
+
+# ---------------------------------------------------------------------------
+# tensor parallel: the hierarchy is topology-blind
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.multichip
+def test_tp8_prefix_parity():
+    """Block tables carry block IDS, so the prefix cache works unchanged on
+    an 8-way head-sharded engine: tp=8 cache-on output token-identical to
+    tp=8 cache-off."""
+    model = build_model("tiny", num_heads=8)
+    params = model.init(jax.random.PRNGKey(0))
+
+    def mk(prefix):
+        cfg = RaggedInferenceEngineConfig(
+            kv_block_size=BS, prefill_chunk_size=CHUNK, dtype="float32",
+            max_ragged_batch_size=4, frame_steps=2, tp=8,
+            prefix_cache=prefix)
+        return InferenceEngineV2(model, cfg, params=params, max_seq_len=160)
+
+    base = dict(mk(False).serve(_shared_arrivals(3), max_new_tokens=8))
+    e = mk(True)
+    outs = dict(e.serve(_shared_arrivals(3), max_new_tokens=8))
+    for u in base:
+        np.testing.assert_array_equal(base[u], outs[u],
+                                      err_msg=f"uid={u} diverged under tp=8")
+    assert e.telemetry.counters["prefix_hits"] >= 1
+    _clean(e)

@@ -157,6 +157,72 @@ def test_swapper_swap_in_finalizes_pending_writes(tmp_path):
     np.testing.assert_array_equal(sw.swap_in("k"), good)
 
 
+class _DeferredAIO:
+    """aio stub whose writes EXECUTE only at wait() — modeling a queued
+    async write still sitting in the aio engine when the host moves on."""
+
+    def __init__(self):
+        self._queued = []
+
+    def async_pwrite(self, arr, path):
+        self._queued.append((bytes(np.ascontiguousarray(arr).tobytes()), path))
+
+    def async_pread(self, arr, path):
+        raise AssertionError("no reads expected")
+
+    def wait(self):
+        for payload, path in self._queued:
+            with open(path, "wb") as f:
+                f.write(payload)
+        self._queued.clear()
+        return 0
+
+
+def test_swapper_release_drains_inflight_writes(tmp_path):
+    """Known issue (b): release() on a key with an un-waited async
+    swap_out used to pop the pending record and delete files EAGERLY —
+    the still-queued aio write then recreated the just-deleted
+    ``.swp.tmp`` after the fact, stranding a staging file (and a later
+    wait() had no pending record to finalize or roll it back). release()
+    must drain in-flight writes first."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path), aio_handle=_DeferredAIO())
+    sw.swap_out("k", np.arange(8, dtype=np.float32), async_op=True)
+    sw.release("k")                      # write still queued in the engine
+    assert sw.wait() == 0
+    assert list(tmp_path.iterdir()) == []    # no resurrected .swp.tmp/.swp
+    assert "k" not in sw._meta and not sw._pending
+
+
+def test_swapper_release_drain_commits_siblings(tmp_path):
+    """Draining inside release() must finalize SIBLING pending writes
+    through the normal atomic-commit path, not drop them."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    sw = AsyncTensorSwapper(str(tmp_path), aio_handle=_DeferredAIO())
+    keep = np.arange(4, dtype=np.float32)
+    sw.swap_out("keep", keep, async_op=True)
+    sw.swap_out("gone", np.ones(4, np.float32), async_op=True)
+    sw.release("gone")
+    assert sorted(p.name for p in tmp_path.iterdir()) == ["keep.swp"]
+    from deepspeed_tpu.ops.aio import AsyncIOHandle
+    sw.aio = AsyncIOHandle()
+    np.testing.assert_array_equal(sw.swap_in("keep"), keep)
+
+
+def test_swapper_adopt_cross_instance(tmp_path):
+    """adopt(): a fresh swapper instance reads a committed .swp written by
+    a previous one (crash-recovery path for the KV swap tier)."""
+    from deepspeed_tpu.runtime.swap_tensor.swapper import AsyncTensorSwapper
+    first = AsyncTensorSwapper(str(tmp_path))
+    data = np.arange(12, dtype=np.float32).reshape(3, 4)
+    first.swap_out("x", data)
+    fresh = AsyncTensorSwapper(str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        fresh.adopt("missing", (1,), np.float32)
+    fresh.adopt("x", data.shape, data.dtype)
+    np.testing.assert_array_equal(fresh.swap_in("x"), data)
+
+
 def test_swapper_async_batch_failure_names_keys(tmp_path):
     """The async path (OptimizerSwapper's batched swap_out) finalizes at
     wait(): on error every pending write rolls back and the raise names

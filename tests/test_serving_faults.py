@@ -437,12 +437,19 @@ def test_resume_through_scheduler_preserves_metadata(tiny_model_params):
     _assert_clean(e)
 
 
-def test_resume_shed_by_scheduler_releases_descriptor(tiny_model_params):
-    """A resumed request shed at re-submission (tenant queue quota) must
-    drop the descriptor the resume ingestion just created — otherwise the
-    uid is poisoned forever ('already tracked' on any later arrival)."""
+def test_resume_bypasses_tenant_queue_quota(tiny_model_params):
+    """Known issue (a): crash-recovery resume used to route previously-live
+    requests through ``sched.submit()``, so ``tenant_max_queued`` could
+    shed ACCEPTED mid-flight work and silently drop its committed tokens.
+    Resume ingestion now bypasses the queue quota (the ``requeue_front``
+    precedent for preempted work): every snapshot request completes,
+    token-identical to the crash-free run, even when the tenant's quota is
+    smaller than its in-flight count — and new (non-resume) arrivals still
+    face the quota."""
     model, params = tiny_model_params
     e = _engine(model, params)
+    base = dict(e.serve([[(0, PROMPTS[0]), (1, PROMPTS[1])]],
+                        max_new_tokens=8))
     inj = FaultInjector([{"kind": "dispatch_exception", "frame": 1,
                           "times": 10}])
 
@@ -455,12 +462,20 @@ def test_resume_shed_by_scheduler_releases_descriptor(tiny_model_params):
                      faults=inj))
     snap = e.last_crash_snapshot
     assert {r["uid"] for r in snap["requests"]} == {0, 1}
-    # resume into a scheduler whose queue quota sheds the second request
+    # a quota of 1 would have shed uid 1 pre-fix; resume must not shed
     s = RequestScheduler(SchedulerConfig(tenant_max_queued=1))
     got = dict(e.serve(iter([[]]), max_new_tokens=8, scheduler=s,
                        resume_from=snap))
-    assert set(got) == {0}
-    assert s.stats()["shed_total"] == 1
+    assert set(got) == {0, 1}
+    assert s.stats()["shed_total"] == 0
+    for u in (0, 1):
+        np.testing.assert_array_equal(base[u], got[u], err_msg=f"uid={u}")
+    # the quota still applies to NEW submissions on the same scheduler
+    from deepspeed_tpu.inference.v2.scheduler import Request
+    s.submit(Request(uid=90, tokens=PROMPTS[0], limit=8, temp=0.0,
+                     eos=None, tenant="t"))
+    assert s.submit(Request(uid=91, tokens=PROMPTS[1], limit=8, temp=0.0,
+                            eos=None, tenant="t")) is not None
     _assert_clean(e)
     # the shed uid stays reusable
     again = dict(e.serve(iter([[(1, PROMPTS[1])]]), max_new_tokens=4))

@@ -280,7 +280,8 @@ def serving_programs():
 #: leading wrapper-only params of each runner entry point (the jit sees
 #: the args after them), mirroring the call-site shift in DISPATCH_DONATIONS
 _WRAPPER_OFFSET = {"frame_loop": 0, "frame_loop_spec": 1, "mixed_loop": 0,
-                   "mixed_loop_spec": 1, "decode_loop": 0, "run": 1}
+                   "mixed_loop_spec": 1, "decode_loop": 0, "run": 1,
+                   "copy_blocks": 0, "scatter_pages": 0}
 
 
 def test_dispatch_donation_table_matches_live_traces(serving_programs):
