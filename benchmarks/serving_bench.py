@@ -781,6 +781,156 @@ def bench_chaos(model_name, batch, prompt_len, new_tokens, n_arrivals=12):
     }
 
 
+def bench_router(model_name, batch, prompt_len, new_tokens, n_arrivals=12):
+    """Multi-engine router: fleet goodput under a deterministic engine-kill
+    schedule vs the no-failure fleet baseline, on one deterministic arrival
+    schedule (one arrival per router tick, every request pinned to ONE
+    replica by session affinity so the kill actually orphans work).
+
+    Three measured legs:
+
+    * **single** — one engine, no router (the pre-PR reference; its greedy
+      outputs are THE parity target for both fleet legs);
+    * **fleet** — two replicas behind ``EngineRouter``, fault-free
+      (placement + cooperative stepping overhead);
+    * **kill+failover** — same schedule, the affinity-pinned replica
+      hard-killed mid-stream by the scripted ``RouterFaultInjector``; the
+      router splits its snapshot per-request and re-admits everything on
+      the survivor. Reports the kill/baseline goodput ratio and the
+      router's failover ``recovery_ms`` (last kill -> every orphaned
+      request re-placed on a healthy peer's feed).
+
+    Correctness is asserted inline (every accepted request completes on
+    every leg, token-identical to the single-engine run; zero
+    requests_failed; the victim ends quarantined) — the row doubles as a
+    smoke check, mirroring bench_chaos's tested-contract style."""
+    import jax
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.faults import RouterFaultInjector
+    from deepspeed_tpu.inference.v2.router import (EngineRouter,
+                                                   RouterConfig, QUARANTINED)
+    from deepspeed_tpu.models import build_model
+
+    # one model + params shared by every replica: heterogeneous DEGREES are
+    # the tests' business (tp=1<->tp=8 under the multichip marker); the
+    # bench measures routing overhead and failover, which need identical
+    # weights for the token-identity asserts to mean anything
+    model = build_model(model_name)
+    params = model.init(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(29)
+    prompts = [rng.integers(0, model.cfg.vocab_size - 5,
+                            (prompt_len,)).astype(np.int32)
+               for _ in range(n_arrivals)]
+
+    def arrivals():
+        # dict arrivals, ALL up front, one session: affinity pins the
+        # whole stream to a single replica and the front-loaded queue
+        # (slots < arrivals) guarantees the tick-3 kill orphans live rows
+        # AND queued work — the failover path under real load, not a kill
+        # of an already-idle replica
+        yield [{"uid": u, "tokens": p, "session": "pinned"}
+               for u, p in enumerate(prompts)]
+
+    def mk():
+        # slots below the arrival count build a real queue; small frames
+        # keep requests in flight across several router ticks
+        cfg = RaggedInferenceEngineConfig(
+            max_ragged_batch_size=batch,
+            max_tokens_per_step=max(batch * 2, 768),
+            frame_steps=2,
+            expected_context=prompt_len + new_tokens,
+            expected_concurrency=batch)
+        eng = InferenceEngineV2(model, cfg, params=params,
+                                max_seq_len=prompt_len + new_tokens + 2)
+        eng._config.frame_retry_backoff_s = 0.0   # measure work, not sleep
+        return eng
+
+    engines = {"a": mk(), "b": mk()}
+
+    def run(router=None, faults=None):
+        src = engines["a"].serve(arrivals(), max_new_tokens=new_tokens) \
+            if router is None else \
+            router.serve(arrivals(), max_new_tokens=new_tokens,
+                         faults=faults)
+        outs, produced = {}, 0
+        t0 = time.perf_counter()
+        for uid, toks in src:
+            outs[uid] = toks
+            produced += len(toks)
+        return outs, produced, time.perf_counter() - t0
+
+    run()                                            # compile engine a
+    # compile engine b too (the failover leg lands everything on it; a
+    # cold survivor would bill its frame compiles to recovery)
+    outs_b, _, _ = run(EngineRouter({"b": engines["b"]}))
+    base_outs, base_produced, base_dt = run()
+    for u, toks in outs_b.items():
+        np.testing.assert_array_equal(
+            base_outs[u], toks, err_msg=f"uid={u}: replicas diverged")
+
+    # backoff must exceed the WORST-CASE run length in ticks (the big TPU
+    # workload runs for hundreds of decode ticks): if the victim rejoins
+    # mid-run, the final QUARANTINED assert below fails even though
+    # failover itself worked
+    mk_router = lambda: EngineRouter(    # noqa: E731 — two identical legs
+        engines, RouterConfig(quarantine_backoff_ticks=1 << 20))
+    fleet_outs, fleet_produced, fleet_dt = run(mk_router())
+    for u, toks in fleet_outs.items():
+        np.testing.assert_array_equal(
+            base_outs[u], toks, err_msg=f"uid={u} diverged behind router")
+
+    router = mk_router()
+    victim = router._pick("pinned")
+    inj = RouterFaultInjector(
+        [{"kind": "engine_kill", "tick": 3, "engine": victim}])
+    kill_outs, kill_produced, kill_dt = run(router, faults=inj)
+    for u, toks in kill_outs.items():
+        np.testing.assert_array_equal(
+            base_outs[u], toks,
+            err_msg=f"uid={u} diverged across kill+failover")
+    assert set(kill_outs) == set(base_outs), \
+        "every accepted request must complete across the failover"
+    st = router.stats()
+    assert st["counters"]["requests_failed"] == 0
+    assert st["counters"]["engine_kills"] == 1
+    assert st["counters"]["reroutes"] >= 1, \
+        "the kill must orphan in-flight work (else the leg measured nothing)"
+    assert st["replicas"][victim] == QUARANTINED
+    for eng in engines.values():
+        assert eng.kv.free_blocks == eng.kv.num_blocks - 1, \
+            "KV pool must drain on every replica"
+
+    base_tps = base_produced / base_dt
+    fleet_tps = fleet_produced / fleet_dt
+    kill_tps = kill_produced / kill_dt
+    return {
+        "workload": "router-failover", "batch": batch,
+        "prompt_len": prompt_len, "new_tokens": new_tokens,
+        "arrivals": n_arrivals, "replicas": 2,
+        "kill_schedule": [{"kind": "engine_kill", "tick": 3,
+                           "engine": victim}],
+        "single_engine_tok_per_sec": round(base_tps, 1),
+        "fleet_tok_per_sec": round(fleet_tps, 1),
+        "fleet_goodput_ratio": round(fleet_tps / base_tps, 4),
+        "kill_tok_per_sec": round(kill_tps, 1),
+        "kill_goodput_ratio": round(kill_tps / base_tps, 4),
+        "recovery_ms": router.last_recovery_ms,
+        "router_counters": {k: st["counters"][k]
+                            for k in ("placements", "failovers", "reroutes",
+                                      "completions", "requests_failed")},
+        "note": "same deterministic pinned-session schedule all three "
+                "legs; fleet leg measures routing overhead (one engine "
+                "does the work — affinity pins the session), kill leg "
+                "hard-kills the pinned replica at tick 3 and fails every "
+                "in-flight request over to the survivor via per-request "
+                "snapshot split (outputs asserted token-identical to the "
+                "single-engine run, zero requests_failed); recovery_ms is "
+                "kill -> all orphans re-placed, excluding the survivor's "
+                "own re-prefill (its recovery gauges cover that)",
+    }
+
+
 def bench_prefix_cache(model_name, batch, prompt_len, new_tokens,
                        n_arrivals=12, tail_len=8,
                        assert_contract=True):
@@ -1220,6 +1370,12 @@ def main():
                          "TTFT p50/p90 and goodput vs the cold baseline, "
                          "with inline token-identity asserts and the >=2x "
                          "TTFT-p90-at->=50%%-hit-rate acceptance contract)")
+    ap.add_argument("--router", action="store_true",
+                    help="run only the router-failover row (single engine "
+                         "vs a 2-replica EngineRouter fleet, fault-free "
+                         "and under a deterministic engine-kill schedule: "
+                         "goodput ratios + failover recovery_ms, with "
+                         "inline token-identity asserts)")
     ap.add_argument("--chaos", action="store_true",
                     help="run only the chaos-serving row (fault-free "
                          "baseline vs a fixed fault schedule — transient "
@@ -1331,6 +1487,28 @@ def main():
         # the inline token-identity + >=2x-TTFT asserts are a hard
         # contract, exactly like the telemetry budget
         if any(r.get("workload") == "prefix-cache"
+               and r.get("error_type") == "AssertionError" for r in rows):
+            sys.exit(1)
+        return
+
+    if args.router:
+        # focused mode: the multi-engine failover row only
+        b, p, n, arr = mixed_dynamic
+        guarded("router-failover", bench_router, model, b, p, n,
+                n_arrivals=max(arr, 8))
+        row = next((r for r in rows
+                    if r.get("workload") == "router-failover"), {})
+        print(json.dumps({
+            "metric": "fastgen_serving_router",
+            "model": model, "platform": platform,
+            "value": row.get("kill_goodput_ratio"),
+            "unit": "kill+failover/single-engine goodput ratio "
+                    "(deterministic engine-kill schedule)",
+            "rows": rows,
+        }))
+        # the inline token-identity / completion asserts are a hard
+        # contract, exactly like the telemetry budget
+        if any(r.get("workload") == "router-failover"
                and r.get("error_type") == "AssertionError" for r in rows):
             sys.exit(1)
         return

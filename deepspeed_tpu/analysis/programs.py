@@ -123,9 +123,22 @@ def _engine_programs(eng, tag: str) -> List[TracedProgram]:
                  dict(width=8, steps=2, greedy=True)),
         _program(f"frame_loop[w=1]{tag}", runner._build_frame_loop, frame(),
                  dict(width=1, steps=2, greedy=True)),
+        # nonfinite_policy="repair" compiles DISTINCT programs (the
+        # pre-fault-carry rollback selects are static-gated) — a repair
+        # engine runs the repair variant of EVERY frame program it
+        # dispatches (wide prefill frames and the speculative loop
+        # included), so each needs its own GL001-GL004 coverage
+        _program(f"frame_loop[w=1,repair]{tag}", runner._build_frame_loop,
+                 frame(), dict(width=1, steps=2, greedy=True, repair=True)),
+        _program(f"frame_loop[w=8,repair]{tag}", runner._build_frame_loop,
+                 frame(), dict(width=8, steps=2, greedy=True, repair=True)),
         _program(f"frame_loop_spec[w=1]{tag}",
                  lambda: runner._build_frame_loop_spec(draft_runner), spec(),
                  dict(width=1, steps=2, greedy=True, gamma=_GAMMA)),
+        _program(f"frame_loop_spec[w=1,repair]{tag}",
+                 lambda: runner._build_frame_loop_spec(draft_runner), spec(),
+                 dict(width=1, steps=2, greedy=True, gamma=_GAMMA,
+                      repair=True)),
         _program(f"mixed_loop{tag}", runner._build_mixed_loop,
                  (eng.params, prompts, plens, limits, kv.k, kv.v, tables,
                   rng, temp),

@@ -4,9 +4,12 @@ The telemetry, scheduler, and fault-tolerance surfaces are re-exported
 here so serving front-ends can build scrape endpoints, admission policies,
 and chaos/recovery harnesses without reaching into module internals."""
 
+from .engine_v2 import ServeBoundary  # noqa: F401
 from .faults import (FaultInjector, FaultReason,  # noqa: F401
-                     FaultSpec, FrameDispatchError, InjectedFault)
+                     FaultSpec, FrameDispatchError, InjectedFault,
+                     RouterFaultInjector, RouterFaultSpec, snapshot_split)
 from .kv_hierarchy import KVSwapTier, PrefixCache  # noqa: F401
+from .router import EngineRouter, RouterConfig  # noqa: F401
 from .scheduler import (RequestScheduler, SchedulerConfig,  # noqa: F401
                         ShedReason)
 from .telemetry import LogBucketHistogram, ServingTelemetry  # noqa: F401

@@ -102,6 +102,19 @@ class RaggedInferenceEngineConfig:
     # actual recovery mechanism) can act at the next boundary.
     watchdog_frame_ms: Optional[float] = None
     fault_log_max: int = 256
+    # what the frame boundary does with a row whose in-graph finite-check
+    # latch tripped (README "Fault tolerance & chaos testing"):
+    #   "quarantine" (default) — evict + retire with a poison_row fault
+    #     (the batch never dies for one request);
+    #   "repair"     — the compiled frame rolls the row back to its
+    #     pre-fault carry instead of freezing it (a transient blip — an
+    #     ECC hiccup, a one-off numeric spike — costs the row one frame,
+    #     not its life), and the host escalates to quarantine only after
+    #     nonfinite_repair_limit CONSECUTIVE latched boundaries. Repair
+    #     compiles a distinct frame program (static flag), so the default
+    #     path stays byte-identical.
+    nonfinite_policy: str = "quarantine"
+    nonfinite_repair_limit: int = 2
     # tensor-parallel serving (README "Multi-chip serving"): shard the model
     # weights (Megatron column/row via parallel/sharding.py rules) and the
     # paged KV pools (head-wise) across a 1-D tp mesh of the first `tp`
@@ -148,6 +161,28 @@ class RaggedInferenceEngineConfig:
     # kv_swap_dir; False keeps the PR-4 re-prefill path)
     kv_swap_preempt: bool = True
     dtype: str = "bfloat16"
+
+
+@dataclasses.dataclass
+class ServeBoundary:
+    """One frame-boundary progress event, yielded by
+    ``serve(..., yield_boundaries=True)`` between request completions.
+
+    This is the cooperative-scheduling hook the multi-engine router
+    (``router.py``) is built on: every ``next()`` on the serve generator
+    advances the engine by AT MOST one frame (or one idle arrival poll)
+    before control returns to the caller, and the event doubles as the
+    engine's progress HEARTBEAT — ``t`` is the engine clock at the
+    boundary, so a front-end can detect a replica whose frames have
+    stopped making wall-clock progress. Plain consumers that never pass
+    ``yield_boundaries`` see the historical ``(uid, tokens)``-only
+    stream, byte-identical."""
+    index: int          # frame-boundary index (the fault-schedule clock)
+    dispatched: bool    # False for an idle poll (nothing live, no frame)
+    live: int           # live slots after this boundary's retirements
+    queued: int         # engine-side queue depth (FIFO deque / scheduler)
+    free_slots: int
+    t: float            # engine clock (time.monotonic unless injected)
 
 
 class InferenceEngineV2:
@@ -202,6 +237,21 @@ class InferenceEngineV2:
         self._ledger: Dict[int, LedgerEntry] = {}
         self._resume_pending: set = set()
         self._clock = time.monotonic
+        # nonfinite handling (faults.py): "repair" compiles the rollback
+        # variant of the frame programs; the host tracks consecutive
+        # latched boundaries per row to escalate persistent faults
+        if c.nonfinite_policy not in ("quarantine", "repair"):
+            raise ValueError(
+                f"nonfinite_policy={c.nonfinite_policy!r}: expected "
+                "'quarantine' or 'repair'")
+        if c.nonfinite_repair_limit < 1:
+            raise ValueError("nonfinite_repair_limit must be >= 1")
+        self._nonfinite_repair = c.nonfinite_policy == "repair"
+        self._repair_counts: Dict[int, int] = {}
+        # graceful drain (router.py): while set, serve() boundaries stop
+        # ADMITTING queued work — live rows run to completion, the queue
+        # holds, and the router migrates it via snapshot_serving_state()
+        self._draining = False
         # KV memory hierarchy (kv_hierarchy.py): host-RAM swap tier +
         # prefix cache with copy-on-write block sharing. Both default off;
         # the cache rides the refcounted allocator, so cache-off paths are
@@ -342,6 +392,20 @@ class InferenceEngineV2:
         object with ``write_events([(tag, value, step)])``) at frame
         boundaries — the serving twin of the training engine's monitor."""
         self.telemetry.attach_monitor(monitor, every_frames=every_frames)
+
+    def begin_drain(self) -> None:
+        """Graceful-drain hook (router replica removal): from the next
+        frame boundary on, ``serve()`` stops admitting queued work — live
+        rows keep decoding to completion while the queue holds. Once the
+        live count hits zero the queue is exactly the engine's ledger, so
+        ``snapshot_serving_state()`` + ``faults.snapshot_split()`` migrate
+        it to a healthy peer without losing an accepted request."""
+        self._draining = True
+
+    def end_drain(self) -> None:
+        """Cancel a drain (replica kept after all): admission resumes at
+        the next frame boundary."""
+        self._draining = False
 
     # ------------------------------------------------------------------
     # admission control (reference engine_v2.py:184)
@@ -653,7 +717,18 @@ class InferenceEngineV2:
     @staticmethod
     def _norm_arrival(item, max_new_tokens, temperature, eos_token_id):
         """Normalize one arrival to ``(uid, tokens, limit, temp, eos,
-        tenant, priority, slo_ms, deadline_ms)``.
+        tenant, priority, slo_ms, deadline_ms, generated)``.
+
+        ``generated`` (dict arrivals only; normally None) marks a RESUME
+        arrival — the router's cross-engine failover/migration surface
+        (``faults.snapshot_split``): ``tokens`` is the ORIGINAL prompt,
+        ``generated`` the tokens another engine already committed, and
+        ``max_new_tokens`` the ORIGINAL budget. Ingestion folds
+        prompt+generated for re-prefill (the crash-resume machinery), the
+        ledger keeps the original prompt/limit, and on the scheduler path
+        the submit bypasses the tenant queue quota — the request was
+        already accepted once. An empty list is still a resume (a queued,
+        never-admitted request migrating off a drained replica).
 
         Tuple form: ``(uid, tokens[, max_new_tokens[, temperature[,
         eos_id]]])`` with serve()-level defaults filled in; None in any
@@ -685,6 +760,14 @@ class InferenceEngineV2:
             deadline_ms = item.get("deadline_ms")
             if deadline_ms is not None and deadline_ms <= 0:
                 raise ValueError(f"uid={uid}: deadline_ms must be > 0")
+            generated = item.get("generated")
+            if generated is not None:
+                generated = [int(t) for t in generated]
+                if len(generated) > int(limit):
+                    raise ValueError(
+                        f"uid={uid}: resume arrival carries "
+                        f"{len(generated)} committed tokens beyond its "
+                        f"budget of {limit}")
         else:
             uid, toks = item[0], item[1]
             limit = item[2] if len(item) > 2 and item[2] is not None \
@@ -693,16 +776,17 @@ class InferenceEngineV2:
                 else temperature
             eos = item[4] if len(item) > 4 and item[4] is not None \
                 else eos_token_id
-            tenant = prio = slo_ms = deadline_ms = None
+            tenant = prio = slo_ms = deadline_ms = generated = None
         return uid, np.asarray(toks, np.int32).reshape(-1), int(limit), \
-            float(temp), eos, tenant, prio, slo_ms, deadline_ms
+            float(temp), eos, tenant, prio, slo_ms, deadline_ms, generated
 
     def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
               temperature: float = 0.0, eos_token_id: Optional[int] = None,
               frame_steps: Optional[int] = None,
               frame_slots: Optional[int] = None,
               speculate: Optional[bool] = None, gamma: Optional[int] = None,
-              rng=None, scheduler=None, faults=None, resume_from=None):
+              rng=None, scheduler=None, faults=None, resume_from=None,
+              yield_boundaries: bool = False):
         """Continuous batching with dynamic arrivals at compiled-loop speed.
 
         Generator: yields ``(uid, generated_tokens)`` as sequences finish.
@@ -771,6 +855,13 @@ class InferenceEngineV2:
         scripted schedule exercises these paths deterministically (chaos
         tests, ``serving_bench.py --chaos``).
 
+        ``yield_boundaries=True`` additionally yields a ``ServeBoundary``
+        event at every frame boundary (after that boundary's retirements),
+        turning the generator into a cooperatively-steppable loop: one
+        ``next()`` advances the engine by at most one frame. This is the
+        router's scheduling and heartbeat surface (``router.py``); plain
+        consumers keep the ``(uid, tokens)``-only stream.
+
         While a ``serve`` generator is live it owns the engine's scheduler
         state — don't interleave ``step()``/``generate()`` calls.
         """
@@ -817,6 +908,8 @@ class InferenceEngineV2:
             self.kv_swap.prune_requests({r[0] for r in resume})
         self._ledger = {}
         self._resume_pending = {r[0] for r in resume}
+        self._repair_counts = {}
+        self._draining = False
         self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
                                    adaptive=adaptive, n_slots=n_slots,
                                    kv_blocks_total=self.kv.num_blocks,
@@ -826,21 +919,23 @@ class InferenceEngineV2:
             return self._serve_guarded_sched(
                 slots, arrivals, scheduler, steps, max_new_tokens,
                 temperature, eos_token_id, speculate, gamma, adaptive,
-                faults, resume)
+                faults, resume, yield_boundaries)
         return self._serve_guarded(slots, arrivals, steps, max_new_tokens,
                                    temperature, eos_token_id, speculate,
-                                   gamma, adaptive, faults, resume)
+                                   gamma, adaptive, faults, resume,
+                                   yield_boundaries)
 
     def _serve_guarded(self, slots, arrivals, steps, max_new_tokens,
                        temperature, eos_token_id, speculate, gamma, adaptive,
-                       faults, resume):
+                       faults, resume, boundaries=False):
         pending = collections.deque()
         try:
             yield from self._serve_loop(slots, arrivals, pending, steps,
                                         max_new_tokens, temperature,
                                         eos_token_id, speculate=speculate,
                                         gamma=gamma, adaptive=adaptive,
-                                        faults=faults, resume=resume)
+                                        faults=faults, resume=resume,
+                                        boundaries=boundaries)
         finally:
             # generator abandonment (break / close() / mid-stream error)
             # must not strand in-flight state: release every slot-held
@@ -861,12 +956,14 @@ class InferenceEngineV2:
 
     def _serve_guarded_sched(self, slots, arrivals, sched, steps,
                              max_new_tokens, temperature, eos_token_id,
-                             speculate, gamma, adaptive, faults, resume):
+                             speculate, gamma, adaptive, faults, resume,
+                             boundaries=False):
         try:
             yield from self._serve_loop_sched(
                 slots, arrivals, sched, steps, max_new_tokens, temperature,
                 eos_token_id, speculate=speculate, gamma=gamma,
-                adaptive=adaptive, faults=faults, resume=resume)
+                adaptive=adaptive, faults=faults, resume=resume,
+                boundaries=boundaries)
         finally:
             # same abandonment contract as the FIFO path: slot-held AND
             # scheduler-queued sequences (including preempted ones holding
@@ -996,6 +1093,29 @@ class InferenceEngineV2:
             tenant=tenant, priority=priority, slo_ms=slo_ms,
             resumed_from=resumed_from)
 
+    def _ingest_resume(self, uid, toks, limit, gen, tel):
+        """Shared core of mid-run RESUME-arrival ingestion (router
+        failover / drain migration), used by BOTH serve loops — the
+        FIFO/scheduler difference is only where the folded request is
+        enqueued. Rebuilds the host sequence with the committed tokens and
+        either retires immediately (already over budget: returns
+        ``(None, output)`` — the ledger entry added just before is popped
+        and the retirement recorded) or returns
+        ``((folded_prompt, remaining_budget), None)`` for re-prefill."""
+        seq = self.state.get_or_create_sequence(uid)
+        seq.generated = list(gen)
+        seq.done = False
+        remaining = limit - len(gen)
+        if remaining <= 0:
+            out = np.asarray(seq.generated, np.int64)
+            self.state.flush_sequence(uid)
+            self._ledger.pop(uid, None)
+            tel.on_retire(uid)
+            return None, out
+        folded = np.concatenate([toks, np.asarray(gen, np.int32)]) \
+            if gen else toks
+        return (folded, remaining), None
+
     def _resume_entries(self, resume_from) -> List[Tuple]:
         """Normalize a ``snapshot_serving_state()`` dict into resume
         ingestion tuples (validated eagerly, at the serve() call site)."""
@@ -1039,6 +1159,22 @@ class InferenceEngineV2:
         logger.warning(f"serve(): uid={uid} retired with fault "
                        f"kind={kind} at frame {frame}: {detail}")
 
+    def _note_resume_truncated(self, uid, want, limit, frame: int) -> None:
+        """Heterogeneous failover/migration landed on a peer whose
+        ``max_seq_len`` cannot hold the request's original budget: the
+        clamp makes token-identity with the no-failure run impossible, so
+        record a structured fault (log + ``ds_serving_faults_total{kind=
+        "resume_truncated"}``) instead of letting the shortened output
+        pass as a normal completion. The request still serves what fits —
+        capacity is a physical limit; dropping committed work would be
+        strictly worse."""
+        self.fault_log.append(FaultReason(
+            uid=uid, kind="resume_truncated", frame=frame,
+            detail=f"resume budget clamped {want}->{limit} by "
+                   f"max_seq_len={self.max_seq_len}; output will be "
+                   "shorter than the no-failure run"))
+        self.telemetry.on_fault("resume_truncated", uid=uid)
+
     def _fault_event(self, kind: str, frame: int, detail: str) -> None:
         """Frame-level fault event (no single victim request): retries,
         slow frames, injected allocation failures, fatal crashes."""
@@ -1079,13 +1215,18 @@ class InferenceEngineV2:
                                detail=f"deadline_ms elapsed while {where}",
                                partial=partial)
 
-    def _quarantine_nonfinite(self, slots, frame: int, sched=None) -> None:
-        """Poison-row quarantine: rows whose in-graph finite-check latch
-        tripped during the last frame are evicted (the preemption path:
-        freeze + free slot + free KV blocks) and retired with a
+    def _quarantine_rows(self, uids, slots, frame: int, sched=None,
+                         escalated: bool = False) -> None:
+        """Poison-row quarantine: latched rows are evicted (the preemption
+        path: freeze + free slot + free KV blocks) and retired with a
         ``poison_row`` FaultReason — the batch never dies for one request.
         One tiny boundary read (``nonfinite_uids``), nothing in-frame."""
-        for uid in slots.nonfinite_uids():
+        detail = ("non-finite logits persisted past nonfinite_repair_limit="
+                  f"{self._config.nonfinite_repair_limit} boundaries; row "
+                  "quarantined, siblings unaffected") if escalated else \
+            ("non-finite logits (in-graph finite-check); row quarantined, "
+             "siblings unaffected")
+        for uid in uids:
             seq = self.state.seqs.get(uid)
             partial = list(seq.generated) if seq is not None else []
             slots.evict(uid)
@@ -1097,11 +1238,59 @@ class InferenceEngineV2:
                 # a healthy request
                 self.prefix_cache.invalidate_uid(uid)
             self.state.flush_sequence(uid)
-            self._fault_retire(
-                uid, "poison_row", frame,
-                detail="non-finite logits (in-graph finite-check); row "
-                       "quarantined, siblings unaffected",
-                partial=partial)
+            self._repair_counts.pop(uid, None)
+            self._fault_retire(uid, "poison_row", frame, detail=detail,
+                               partial=partial)
+
+    def _handle_nonfinite(self, slots, frame: int, sched=None) -> List[int]:
+        """Frame-boundary dispatch for latched finite-check rows. Under the
+        default ``quarantine`` policy every latched row is evicted/retired.
+        Under ``repair`` the compiled frame already rolled each latched row
+        back to its pre-fault carry — a row is given another chance (latch
+        and poison flag cleared; one batched boundary write) until it has
+        latched ``nonfinite_repair_limit`` CONSECUTIVE boundaries, at which
+        point the blip is a persistent fault and the row escalates to the
+        quarantine path. Returns the repaired uids so the caller can
+        resync their committed-watermark mirrors after the host replay
+        (``DeviceSlotTable.resync_committed``).
+
+        Repaired rows keep their published prefix blocks: the per-step
+        finite check gates the watermark, so every page at or below it was
+        verified finite before it could be published."""
+        flagged = slots.nonfinite_uids()
+        if not self._nonfinite_repair:
+            if flagged:
+                self._quarantine_rows(flagged, slots, frame, sched=sched)
+            return []
+        # a clean boundary resets a row's consecutive-blip count
+        for uid in [u for u in self._repair_counts if u not in flagged]:
+            self._repair_counts.pop(uid)
+        repaired, doomed = [], []
+        for uid in flagged:
+            n = self._repair_counts.get(uid, 0) + 1
+            if n > self._config.nonfinite_repair_limit:
+                doomed.append(uid)
+            else:
+                self._repair_counts[uid] = n
+                repaired.append(uid)
+        if doomed:
+            self._quarantine_rows(doomed, slots, frame, sched=sched,
+                                  escalated=True)
+        if repaired:
+            slots.clear_nonfinite(repaired)
+            for uid in repaired:
+                seq = self.state.seqs.get(uid)
+                self.fault_log.append(FaultReason(
+                    uid=uid, kind="nonfinite_repaired", frame=frame,
+                    detail=f"non-finite logits; row rolled back to its "
+                           f"pre-fault carry (blip "
+                           f"{self._repair_counts[uid]}/"
+                           f"{self._config.nonfinite_repair_limit})",
+                    tokens_emitted=len(seq.generated) if seq else 0))
+                # no uid passed: the request is still in flight, its
+                # lifecycle span must survive the blip
+                self.telemetry.on_fault("nonfinite_repaired")
+        return repaired
 
     def _run_frame_resilient(self, slots, width, cur_steps, greedy, draft,
                              faults, frame: int):
@@ -1124,7 +1313,8 @@ class InferenceEngineV2:
                     faults.before_dispatch(frame, attempt)
                 toks, emit = slots.run_frame(self.runner, self.params,
                                              self.kv, width, cur_steps,
-                                             greedy, draft=draft)
+                                             greedy, draft=draft,
+                                             repair=self._nonfinite_repair)
                 dt_ms = (self._clock() - t0) * 1e3
                 if c.watchdog_frame_ms is not None \
                         and dt_ms > c.watchdog_frame_ms:
@@ -1381,7 +1571,8 @@ class InferenceEngineV2:
 
     def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
                     temperature, eos_token_id, speculate=False, gamma=0,
-                    adaptive=False, faults=None, resume=()):
+                    adaptive=False, faults=None, resume=(),
+                    boundaries=False):
         c = self._config
         tel = self.telemetry
         alpha = c.frame_steps_ewma_alpha
@@ -1434,13 +1625,33 @@ class InferenceEngineV2:
                 # for this round, so a bad request can't strand blocks
                 # already reserved for earlier items in the same batch
                 for item in (batch or []):
-                    uid, toks, limit, temp, eos, _ten, _pri, _slo, dl_ms = \
-                        self._norm_arrival(item, max_new_tokens, temperature,
-                                           eos_token_id)
+                    (uid, toks, limit, temp, eos, _ten, _pri, _slo, dl_ms,
+                     gen) = self._norm_arrival(item, max_new_tokens,
+                                               temperature, eos_token_id)
+                    want = limit
                     limit = self._validate_arrival(
                         uid, toks, limit,
                         in_flight=uid in slots.slot_of_uid or
                         any(p[0] == uid for p in pending))
+                    if gen is not None and limit < want:
+                        self._note_resume_truncated(uid, want, limit,
+                                                    boundary)
+                    if gen is not None:
+                        # mid-run RESUME arrival (router failover /
+                        # drain migration): the crash-recovery
+                        # ingestion, fed through the arrival stream;
+                        # ledger keeps the originals
+                        self._ledger_add(uid, toks, limit, temp, eos,
+                                         dl_ms, resumed_from=len(gen))
+                        tel.on_enqueue(uid)
+                        fold, done_out = self._ingest_resume(
+                            uid, toks, limit, gen, tel)
+                        if done_out is not None:
+                            yield uid, done_out
+                            continue
+                        folded, remaining = fold
+                        pending.append((uid, folded, remaining, temp, eos))
+                        continue
                     pending.append((uid, toks, limit, temp, eos))
                     self._ledger_add(uid, toks, limit, temp, eos, dl_ms)
                     tel.on_enqueue(uid)
@@ -1459,7 +1670,7 @@ class InferenceEngineV2:
                     "deferred this boundary")
             admits = []
             blocks_before = self.kv.free_blocks
-            while pending and not alloc_blocked \
+            while pending and not alloc_blocked and not self._draining \
                     and len(admits) < slots.free_slots():
                 uid, toks, limit, temp, eos = pending[0]
                 seq = self.state.get_or_create_sequence(uid)
@@ -1476,7 +1687,7 @@ class InferenceEngineV2:
                 seq.done = False
                 admits.append((uid, seq, toks, limit, temp, eos, cached0))
                 tel.on_admit(uid)
-            if pending:
+            if pending and not self._draining:
                 # overload is otherwise invisible: the deferred arrivals
                 # just wait in FIFO order — count it and warn (rate-limited).
                 # admit() hasn't executed yet, so subtract this round's
@@ -1501,6 +1712,11 @@ class InferenceEngineV2:
             if slots.live_count() == 0:
                 if exhausted and not pending:
                     return
+                if boundaries:
+                    yield ServeBoundary(
+                        index=boundary, dispatched=False, live=0,
+                        queued=len(pending),
+                        free_slots=slots.free_slots(), t=self._clock())
                 continue         # arrival gap: poll the clock again
             # ---- frame plan: wide while any slot prefills, else pure
             # decode at width 1 (two shape buckets total; width-1 frames
@@ -1525,9 +1741,12 @@ class InferenceEngineV2:
                 slots, width, cur_steps, ewma, len(pending), stats_synced)
             # quarantine BEFORE the host replay: a poisoned row's slot is
             # freed here, so absorb neither emits its garbage tail nor
-            # retires it as finished
-            self._quarantine_nonfinite(slots, boundary)
+            # retires it as finished (repair-policy rows survive instead
+            # and get their mirrors resynced after the replay)
+            repaired = self._handle_nonfinite(slots, boundary)
             emissions, finished = slots.absorb(toks, emit, width)
+            if repaired:
+                slots.resync_committed(repaired)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
                 seq.generated.extend(new_toks)
@@ -1547,6 +1766,11 @@ class InferenceEngineV2:
                 self._drop_swap(uid)
                 tel.on_retire(uid)
                 yield uid, out
+            if boundaries:
+                yield ServeBoundary(
+                    index=boundary, dispatched=True,
+                    live=slots.live_count(), queued=len(pending),
+                    free_slots=slots.free_slots(), t=self._clock())
 
     # ------------------------------------------------------------------
     # SLO-aware scheduled serving (scheduler.RequestScheduler)
@@ -1604,7 +1828,7 @@ class InferenceEngineV2:
     def _serve_loop_sched(self, slots, arrivals, sched, steps,
                           max_new_tokens, temperature, eos_token_id,
                           speculate=False, gamma=0, adaptive=False,
-                          faults=None, resume=()):
+                          faults=None, resume=(), boundaries=False):
         """The scheduler-driven twin of ``_serve_loop``: same frame
         execution and retirement contract, but enqueue/admission flow
         through the ``RequestScheduler`` policy object, with an SLO
@@ -1656,7 +1880,8 @@ class InferenceEngineV2:
             # submit never sheds — no rejection handling needed here.
             sched.submit(Request(
                 uid=uid, tokens=folded, limit=remaining, temp=temp,
-                eos=eos, tenant=tenant, priority=prio, slo_ms=slo_ms),
+                eos=eos, tenant=tenant, priority=prio, slo_ms=slo_ms,
+                resumed_from=len(generated), resumed=True),
                 bypass_quota=True)
         while True:
             boundary += 1
@@ -1673,20 +1898,44 @@ class InferenceEngineV2:
                 ewma = alpha * len(batch or []) + (1.0 - alpha) * ewma
                 for item in (batch or []):
                     uid, toks, limit, temp, eos, tenant, prio, slo_ms, \
-                        dl_ms = self._norm_arrival(
+                        dl_ms, gen = self._norm_arrival(
                             item, max_new_tokens, temperature, eos_token_id)
+                    want = limit
                     limit = self._validate_arrival(
                         uid, toks, limit,
                         in_flight=uid in slots.slot_of_uid or
                         sched.is_queued(uid))
+                    if gen is not None and limit < want:
+                        self._note_resume_truncated(uid, want, limit,
+                                                    boundary)
                     prio = normalize_priority(prio)
                     tenant = tenant or "default"
                     self._ledger_add(uid, toks, limit, temp, eos, dl_ms,
                                      tenant=tenant,
                                      priority=PRIORITY_NAMES[prio],
-                                     slo_ms=slo_ms)
+                                     slo_ms=slo_ms,
+                                     resumed_from=len(gen) if gen else 0)
                     tel.on_enqueue(uid, tenant=tenant,
                                    pclass=PRIORITY_NAMES[prio])
+                    if gen is not None:
+                        # mid-run RESUME arrival (router failover / drain
+                        # migration): the submit bypasses the tenant
+                        # queue quota — this request was already accepted
+                        # once, and its committed tokens must not be shed
+                        # at a second admission
+                        fold, done_out = self._ingest_resume(
+                            uid, toks, limit, gen, tel)
+                        if done_out is not None:
+                            yield uid, done_out
+                            continue
+                        folded, remaining = fold
+                        sched.submit(Request(
+                            uid=uid, tokens=folded, limit=remaining,
+                            temp=temp, eos=eos, tenant=tenant,
+                            priority=prio, slo_ms=slo_ms,
+                            resumed_from=len(gen), resumed=True),
+                            bypass_quota=True)
+                        continue
                     shed = sched.submit(Request(
                         uid=uid, tokens=toks, limit=limit, temp=temp,
                         eos=eos, tenant=tenant, priority=prio,
@@ -1713,8 +1962,9 @@ class InferenceEngineV2:
                 self._drop_swap(shed.uid)
             tel.gauges["slo_risk"] = round(sched.risk, 4)
             # ---- frame-boundary preemption: make room for a queued
-            # interactive arrival by evicting a lower-priority live row ----
-            if sched.preempt_wanted(slots.free_slots()):
+            # interactive arrival by evicting a lower-priority live row
+            # (pointless while draining: nothing will be admitted) ----
+            if not self._draining and sched.preempt_wanted(slots.free_slots()):
                 committed = {u: int(slots.committed_h[s])
                              for u, s in slots.slot_of_uid.items()}
                 for uid in sched.pick_victims(
@@ -1739,7 +1989,7 @@ class InferenceEngineV2:
                 return (seq, cached0)
 
             admits = []
-            if not alloc_blocked:
+            if not alloc_blocked and not self._draining:
                 for req, res in sched.pick(slots.free_slots(), try_reserve,
                                            live_count=slots.live_count()):
                     seq, cached0 = res
@@ -1748,7 +1998,7 @@ class InferenceEngineV2:
                     admits.append((req.uid, seq, req.tokens, req.limit,
                                    req.temp, req.eos, cached0))
                     tel.on_admit(req.uid)
-            if sched.queued_count():
+            if sched.queued_count() and not self._draining:
                 tel.on_defer(
                     queue_depth=sched.queued_count(),
                     frame_steps=tel.serve_view["frame_steps_last"] or steps,
@@ -1765,6 +2015,11 @@ class InferenceEngineV2:
             if slots.live_count() == 0:
                 if exhausted and not sched.queued_count():
                     return
+                if boundaries:
+                    yield ServeBoundary(
+                        index=boundary, dispatched=False, live=0,
+                        queued=sched.queued_count(),
+                        free_slots=slots.free_slots(), t=self._clock())
                 continue
             # ---- frame plan: the scheduler's pressure signal caps the
             # frame length so admission boundaries come around sooner
@@ -1789,8 +2044,10 @@ class InferenceEngineV2:
             stats_synced = self._sync_frame_stats(
                 slots, width, cur_steps, ewma, sched.queued_count(),
                 stats_synced)
-            self._quarantine_nonfinite(slots, boundary, sched=sched)
+            repaired = self._handle_nonfinite(slots, boundary, sched=sched)
             emissions, finished = slots.absorb(toks, emit, width)
+            if repaired:
+                slots.resync_committed(repaired)
             for uid, new_toks in emissions.items():
                 seq = self.state.seqs[uid]
                 seq.generated.extend(new_toks)
@@ -1809,6 +2066,11 @@ class InferenceEngineV2:
                 self._drop_swap(uid)
                 tel.on_retire(uid)
                 yield uid, out
+            if boundaries:
+                yield ServeBoundary(
+                    index=boundary, dispatched=True,
+                    live=slots.live_count(), queued=sched.queued_count(),
+                    free_slots=slots.free_slots(), t=self._clock())
 
     def serialize(self, path: str):
         """Analog of ``engine_v2.py:251`` — snapshot params for fast reload."""

@@ -65,10 +65,24 @@ FAULT_KINDS = ("poison_row", "deadline_expired", "dispatch_failed",
                "dispatch_retry", "slow_frame", "kv_alloc_failed",
                # a KV swap-tier page restore/spill failed; the engine falls
                # back to re-prefill (correctness preserved, work recomputed)
-               "swap_failed")
+               "swap_failed",
+               # nonfinite_policy="repair": a transient non-finite blip was
+               # absorbed in-graph (row rolled back to its pre-fault carry,
+               # NOT retired — the record marks the blip, the request lives)
+               "nonfinite_repaired",
+               # a failover/migration resume landed on a peer whose
+               # max_seq_len cannot hold the original budget: the clamp
+               # breaks token-identity with the no-failure run, so the
+               # truncation is recorded loudly instead of the shortened
+               # output passing as a normal completion
+               "resume_truncated")
 
 INJECTABLE_KINDS = ("dispatch_exception", "kv_alloc_fail", "poison_row",
                     "slow_frame")
+
+# router-level injectable events (router.RouterFaultInjector): keyed by the
+# ROUTER tick, not an engine's frame-boundary index
+ROUTER_INJECTABLE_KINDS = ("engine_kill", "engine_drain")
 
 
 class InjectedFault(RuntimeError):
@@ -224,6 +238,105 @@ class FaultInjector:
                     f"injected dispatch failure (frame={frame} "
                     f"attempt={attempt} "
                     f"{self._dispatch_fired[id(s)]}/{s.times})")
+
+
+@dataclasses.dataclass
+class RouterFaultSpec:
+    """One scripted ROUTER-level fault, keyed by the router's tick clock
+    (one tick = one cooperative pass over every replica — deterministic,
+    no wall clock):
+
+    * ``engine_kill``: at tick ``tick``, the router hard-kills replica
+      ``engine`` — snapshot taken, serve generator closed, replica
+      quarantined, every in-flight request failed over to healthy peers
+      (the chaos-test stand-in for a real crash, exercising the same
+      code path as retry-exhaustion ``FrameDispatchError``).
+    * ``engine_drain``: at tick ``tick``, the router begins a graceful
+      drain of ``engine`` (planned replica removal).
+    """
+    kind: str
+    tick: int
+    engine: str
+
+    def __post_init__(self):
+        if self.kind not in ROUTER_INJECTABLE_KINDS:
+            raise ValueError(f"unknown router fault kind {self.kind!r}: "
+                             f"expected one of {ROUTER_INJECTABLE_KINDS}")
+        if self.tick < 0:
+            raise ValueError("router fault tick must be >= 0")
+
+
+class RouterFaultInjector:
+    """Schedule-driven router fault injection (``EngineRouter.serve(...,
+    faults=)``). Specs may be ``RouterFaultSpec`` instances or plain dicts
+    with the same fields; ``fired`` records every injection in order."""
+
+    def __init__(self, schedule):
+        self.schedule = [s if isinstance(s, RouterFaultSpec)
+                         else RouterFaultSpec(**s) for s in schedule]
+        self.fired: List[Dict] = []
+        self.begin()
+
+    def begin(self) -> None:
+        """Rearm every spec (called by ``EngineRouter.serve()``)."""
+        self._done = {id(s): False for s in self.schedule}
+
+    def _pop(self, kind: str, tick: int) -> List[str]:
+        out = []
+        for s in self.schedule:
+            if s.kind == kind and s.tick == tick and not self._done[id(s)]:
+                self._done[id(s)] = True
+                self.fired.append({"kind": kind, "tick": tick,
+                                   "engine": s.engine})
+                out.append(s.engine)
+        return out
+
+    def kills(self, tick: int) -> List[str]:
+        """Replica names to hard-kill at this tick."""
+        return self._pop("engine_kill", tick)
+
+    def drains(self, tick: int) -> List[str]:
+        """Replica names to begin draining at this tick."""
+        return self._pop("engine_drain", tick)
+
+
+def snapshot_split(snapshot: Dict) -> List[Dict]:
+    """Split a ``snapshot_serving_state()`` snapshot into PER-REQUEST
+    resume arrivals — the dict-arrival form ``serve()`` ingests mid-run
+    (the ``generated`` key marks the re-admission; see
+    ``InferenceEngineV2._norm_arrival``). This is the router's failover
+    currency: a crashed/drained engine's snapshot splits into independent
+    requests, each re-placeable on a DIFFERENT healthy peer — the peers
+    re-prefill prompt + committed tokens, so greedy outputs stay
+    token-identical to the no-failure run, even across heterogeneous TP
+    degrees (the snapshot is engine-shape-agnostic by construction).
+
+    The ledger's eos is the RESOLVED per-request value, so ``None`` maps to
+    the explicit no-EOS sentinel ``-1`` rather than inheriting whatever
+    default the target engine's serve() was started with; an expired
+    deadline maps to an epsilon budget (cancelled at the target's next
+    boundary — the deadline contract, not a silent revival)."""
+    if snapshot.get("version") != 1:
+        raise ValueError("snapshot_split: unrecognized snapshot version "
+                         f"{snapshot.get('version')!r}")
+    out = []
+    for r in snapshot.get("requests", []):
+        item = {
+            "uid": int(r["uid"]),
+            "tokens": [int(t) for t in r["prompt"]],
+            "generated": [int(t) for t in r.get("generated", [])],
+            "max_new_tokens": int(r["limit"]),
+            "temperature": float(r["temp"]),
+            "eos_token_id": -1 if r["eos"] is None else int(r["eos"]),
+        }
+        for k in ("tenant", "priority", "slo_ms"):
+            if r.get(k) is not None:
+                item[k] = r[k]
+        if r.get("deadline_remaining_ms") is not None:
+            item["deadline_ms"] = max(float(r["deadline_remaining_ms"]),
+                                      1e-3)
+        out.append(item)
+    return out
 
 
 def snapshot_ledger(ledger: Dict[int, LedgerEntry], seqs: Dict,

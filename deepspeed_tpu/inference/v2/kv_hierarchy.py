@@ -304,37 +304,51 @@ class PrefixCache:
                 ) -> int:
         """Free up to ``n_blocks`` device blocks from cold UNREFERENCED
         entries (allocator refcount 1 — the cache's own reference), LRU
-        first. With a swap tier the page spills to host RAM and the entry
-        stays matchable (restored on the next hit); without one the entry
-        (and its now-unreachable subtree) is dropped. Returns the number
-        of device blocks actually freed."""
+        first. With a swap tier the pages spill to host RAM as ONE batch
+        (one device gather over the whole cold set, queued async writes
+        committed by a single wait, one index rewrite — a pressure event
+        evicting N blocks used to pay that I/O sequence N times) and the
+        entries stay matchable (restored on the next hit); without one the
+        entry (and its now-unreachable subtree) is dropped. Returns the
+        number of device blocks actually freed."""
         protect = protect or set()
         freed = 0
         cands = sorted((e for e in self._by_id.values()
                         if e.block is not None and e.eid not in protect
                         and self.kv.allocator.refcount(e.block) == 1),
                        key=lambda e: e.last_used)
-        for e in cands:
-            if freed >= n_blocks:
-                break
-            if e.eid not in self._by_id or e.block is None:
-                continue       # dropped/spilled as part of an earlier subtree
-            if self.swap is not None:
-                try:
-                    self.swap.put_block(self._bkey(e), self.kv, e.block,
-                                        draft_kv=self.draft_kv)
-                except Exception as err:   # noqa: BLE001 — drop instead
-                    logger.warning(f"prefix cache: spill of block "
-                                   f"eid={e.eid} failed ({err}); dropping")
+        if self.swap is None:
+            for e in cands:
+                if freed >= n_blocks:
+                    break
+                if e.eid not in self._by_id or e.block is None:
+                    continue   # dropped as part of an earlier subtree
+                freed += self._drop_subtree(e)
+                self.stats["evicted"] += 1
+            return freed
+        batch = [e for e in cands[:n_blocks]
+                 if e.eid in self._by_id and e.block is not None]
+        if not batch:
+            return 0
+        try:
+            self.swap.put_blocks([self._bkey(e) for e in batch], self.kv,
+                                 [e.block for e in batch],
+                                 draft_kv=self.draft_kv)
+        except Exception as err:   # noqa: BLE001 — drop instead
+            # the swapper rolled every in-flight write back (atomic batch
+            # commit); degrade to dropping the cold entries outright
+            logger.warning(f"prefix cache: batched spill of "
+                           f"{len(batch)} blocks failed ({err}); dropping")
+            for e in batch:
+                if e.eid in self._by_id and e.block is not None:
                     freed += self._drop_subtree(e)
                     self.stats["evicted"] += 1
-                    continue
-                self.kv.allocator.free([e.block])
-                e.block = None
-                freed += 1
-                self.stats["swapped_out"] += 1
-            else:
-                freed += self._drop_subtree(e)
+            return freed
+        for e in batch:
+            self.kv.allocator.free([e.block])
+            e.block = None
+            freed += 1
+            self.stats["swapped_out"] += 1
             self.stats["evicted"] += 1
         return freed
 
@@ -410,22 +424,33 @@ class KVSwapTier:
         self.swapper.adopt(key, self._page_shape(kv, n),
                            np.dtype(str(kv.k.dtype)))
 
-    def _put(self, prefix: str, kv, blocks: List[int], draft_kv=None
-             ) -> Dict:
-        kp, vp = kv.read_pages(blocks)
+    def _queue_out(self, prefix: str, kv, kp, vp, draft_kv=None,
+                   dkp=None, dvp=None) -> Dict:
+        """Queue one record's page writes (async) and build its index
+        record — the single definition of the on-disk schema ``_restore``
+        reads, shared by the per-record and batched spill paths. The
+        caller owns the commit (``swapper.wait``)."""
+        n = kp.shape[2]
         self.swapper.swap_out(f"{prefix}_k", kp, async_op=True)
         self.swapper.swap_out(f"{prefix}_v", vp, async_op=True)
         if draft_kv is not None:
-            dkp, dvp = draft_kv.read_pages(blocks)
             self.swapper.swap_out(f"{prefix}_dk", dkp, async_op=True)
             self.swapper.swap_out(f"{prefix}_dv", dvp, async_op=True)
-        self.swapper.wait()      # atomic commit; raises (and rolls back)
-        rec = {"blocks": len(blocks), "draft": draft_kv is not None,
+        rec = {"blocks": n, "draft": draft_kv is not None,
                "dtype": str(kv.k.dtype),
-               "page_shape": list(self._page_shape(kv, len(blocks)))}
+               "page_shape": list(self._page_shape(kv, n))}
         if draft_kv is not None:
-            rec["draft_shape"] = list(self._page_shape(draft_kv,
-                                                       len(blocks)))
+            rec["draft_shape"] = list(self._page_shape(draft_kv, n))
+        return rec
+
+    def _put(self, prefix: str, kv, blocks: List[int], draft_kv=None
+             ) -> Dict:
+        kp, vp = kv.read_pages(blocks)
+        dkp = dvp = None
+        if draft_kv is not None:
+            dkp, dvp = draft_kv.read_pages(blocks)
+        rec = self._queue_out(prefix, kv, kp, vp, draft_kv, dkp, dvp)
+        self.swapper.wait()      # atomic commit; raises (and rolls back)
         return rec
 
     def _restore(self, prefix: str, rec: Dict, kv, dst_blocks: List[int],
@@ -520,6 +545,34 @@ class KVSwapTier:
                                                draft_kv=draft_kv)
         self._save_index()
         self.stats["blocks_out"] += 1
+
+    def put_blocks(self, keys: List[str], kv, blocks: List[int],
+                   draft_kv=None) -> None:
+        """Batched prefix-block spill (``PrefixCache.reclaim`` under
+        pressure): ONE device gather over the whole block list
+        (``read_pages`` already takes lists — the per-block path paid a
+        gather, a committed write pair, and a full index rewrite PER
+        block), all page writes queued async and committed by a SINGLE
+        ``wait``, and ONE index rewrite at the end. Failure semantics
+        match ``put_block``: an aio error rolls every in-flight write back
+        (atomic batch) and nothing enters the index."""
+        assert len(keys) == len(blocks)
+        if not keys:
+            return
+        kp, vp = kv.read_pages(blocks)       # one gather + D2H per pool
+        dkp = dvp = None
+        if draft_kv is not None:
+            dkp, dvp = draft_kv.read_pages(blocks)
+        recs: Dict[str, Dict] = {}
+        for i, key in enumerate(keys):
+            recs[key] = self._queue_out(
+                key, kv, kp[:, :, i:i + 1], vp[:, :, i:i + 1], draft_kv,
+                None if dkp is None else dkp[:, :, i:i + 1],
+                None if dvp is None else dvp[:, :, i:i + 1])
+        self.swapper.wait()                  # single atomic batch commit
+        self._index["blocks"].update(recs)
+        self._save_index()                   # one index rewrite
+        self.stats["blocks_out"] += len(keys)
 
     def restore_block(self, key: str, kv, dst_block: int,
                       draft_kv=None) -> None:

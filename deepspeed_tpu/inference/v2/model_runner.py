@@ -433,10 +433,11 @@ class PagedModelRunner:
         @functools.partial(jax.jit,
                            donate_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15,
                                            16),
-                           static_argnames=("width", "steps", "greedy"))
+                           static_argnames=("width", "steps", "greedy",
+                                            "repair"))
         def loop(params, prompts, prompt_lens, limits, eos_ids, temps, tables,
                  cached, produced, last_tok, done, poison, nonfinite, stats,
-                 rng, kpool, vpool, width, steps, greedy):
+                 rng, kpool, vpool, width, steps, greedy, repair=False):
             """One K-step serving FRAME: the resumable generalization of
             ``mixed_loop``. All per-slot state is carry-IN/carry-OUT, so the
             host only touches the loop at frame boundaries (admit arrivals,
@@ -474,7 +475,7 @@ class PagedModelRunner:
                     stats = stats[0]        # this shard's (N_STATS,) row
                 body = _serving_scan_body(fwd, params, prompts, prompt_lens,
                                           limits, eos_ids, temps, tables,
-                                          width, greedy)
+                                          width, greedy, repair=repair)
                 carry = (cached, produced, last_tok, done, poison, nonfinite,
                          stats, rng, kpool, vpool)
                 carry, (toks, emit) = jax.lax.scan(body, carry, None,
@@ -510,11 +511,12 @@ class PagedModelRunner:
         @functools.partial(jax.jit,
                            donate_argnums=(8, 9, 10, 11, 12, 13, 14, 15, 16,
                                            17, 18, 19, 20),
-                           static_argnames=("width", "steps", "greedy", "gamma"))
+                           static_argnames=("width", "steps", "greedy", "gamma",
+                                            "repair"))
         def loop(params, draft_params, prompts, prompt_lens, limits, eos_ids,
                  temps, tables, cached, produced, last_tok, penult, done,
                  poison, nonfinite, stats, rng, kpool, vpool, dkpool, dvpool,
-                 width, steps, greedy, gamma):
+                 width, steps, greedy, gamma, repair=False):
             """Speculative K-step serving frame: ``frame_loop`` with a second
             model riding the carry. Wide (prefill) frames run the target body
             unchanged while the draft ingests the same chunks (its paged KV
@@ -538,7 +540,7 @@ class PagedModelRunner:
                 body = _serving_scan_body(
                     fwd, params, prompts, prompt_lens, limits, eos_ids,
                     temps, tables, width, greedy,
-                    draft=(draft_fwd, draft_params, gamma))
+                    draft=(draft_fwd, draft_params, gamma), repair=repair)
                 carry = (cached, produced, last_tok, penult, done, poison,
                          nonfinite, stats, rng, kpool, vpool, dkpool, dvpool)
                 carry, (toks, emit) = jax.lax.scan(body, carry, None,
@@ -675,7 +677,8 @@ class PagedModelRunner:
 
 
 def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
-                       temps, tables, width, greedy, draft=None):
+                       temps, tables, width, greedy, draft=None,
+                       repair=False):
     """Shared scan-step for ``mixed_loop`` and ``frame_loop`` — the in-graph
     SplitFuse scheduling arithmetic lives in exactly one place.
 
@@ -706,14 +709,24 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
     carry — ``cached`` (the per-row committed watermark), ``last_tok``,
     ``penult`` and the emit masks all select back to the accepted prefix,
     while rejected target/draft KV entries simply sit beyond the watermark
-    until the next step's writes overwrite them."""
+    until the next step's writes overwrite them.
+
+    ``repair=True`` (``nonfinite_policy="repair"``): a row whose logits go
+    non-finite is not frozen — every carry field selects back to its
+    PRE-STEP value (the step simply never happened for that row; the KV it
+    wrote sits at/above the unchanged committed watermark, exactly like
+    rejected speculation, and the retry overwrites it). The ``nonfinite``
+    latch still reports to the host, which counts consecutive latched
+    boundaries and escalates a persistent fault to the quarantine path."""
     if draft is not None:
         return _spec_scan_body(fwd, params, prompts, prompt_lens, limits,
-                               eos_ids, temps, tables, width, greedy, *draft)
+                               eos_ids, temps, tables, width, greedy, *draft,
+                               repair=repair)
 
     def body(carry, _):
         (cached, produced, last_tok, done, poison, nonfinite, stats, rng,
          kpool, vpool) = carry
+        prev_last, prev_done = last_tok, done
         prefilling, active, w, ids, positions = _wide_plan(
             prompts, prompt_lens, limits, width, cached, produced, last_tok,
             done)
@@ -728,8 +741,14 @@ def _serving_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
                                           prompt_lens, eos_ids, nxt,
                                           last_tok, done)
-        emit, done, nonfinite = _finite_check(logits, active, emit, done,
-                                              nonfinite)
+        emit, done, nonfinite, bad = _finite_check(logits, active, emit,
+                                                   done, nonfinite)
+        if repair:
+            # the row made no progress this step: restore the pre-step
+            # carry (un-freeze, un-advance) — emit is already cleared
+            last_tok = jnp.where(bad, prev_last, last_tok)
+            done = jnp.where(bad, prev_done, done)
+            w = jnp.where(bad, 0, w)
         stats = stats + _stat_delta(
             emitted=emit, active=active,
             prefill_toks=jnp.where(prefilling, w, 0),
@@ -761,11 +780,12 @@ def _finite_check(logits, active, emit, done, nonfinite):
     ``nonfinite`` carry flag, which the host reads at the frame boundary
     (one tiny (B,) read, never inside the frame) to quarantine the row via
     the eviction path. Sibling rows' arithmetic is untouched — the batch
-    never dies for one request."""
+    never dies for one request. Also returns ``bad`` (the per-row detection
+    mask) so the repair policy can select the pre-step carry back in."""
     axes = tuple(range(1, logits.ndim))
     bad = active & ~jnp.all(jnp.isfinite(logits), axis=axes)
     emit = emit & ~(bad if emit.ndim == 1 else bad[:, None])
-    return emit, done | bad, nonfinite | bad
+    return emit, done | bad, nonfinite | bad, bad
 
 
 def _stat_delta(emitted=None, active=None, prefill_toks=None, eos=None,
@@ -821,7 +841,7 @@ def _wide_emit(active, prefilling, cached, w, prompt_lens, eos_ids, nxt,
 
 def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
                     temps, tables, width, greedy, draft_fwd, draft_params,
-                    gamma):
+                    gamma, repair=False):
     """Speculative variant of the serving scan step (see
     ``_serving_scan_body``). Carry: (cached, produced, last_tok, penult,
     done, poison, nonfinite, stats, rng, kpool, vpool, dkpool, dvpool);
@@ -847,6 +867,7 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         def body(carry, _):
             (cached, produced, last_tok, penult, done, poison, nonfinite,
              stats, rng, kpool, vpool, dkpool, dvpool) = carry
+            prev_last, prev_done = last_tok, done
             b = cached.shape[0]
             prefilling, active, w, ids, positions = _wide_plan(
                 prompts, prompt_lens, limits, width, cached, produced,
@@ -874,8 +895,14 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
             emit, last_tok, done = _wide_emit(active, prefilling, cached, w,
                                               prompt_lens, eos_ids, nxt,
                                               last_tok, done)
-            emit, done, nonfinite = _finite_check(logits, active, emit,
-                                                  done, nonfinite)
+            emit, done, nonfinite, bad = _finite_check(logits, active, emit,
+                                                       done, nonfinite)
+            if repair:
+                # pre-step rollback (see _serving_scan_body): the cleared
+                # emit already keeps penult/produced untouched for bad rows
+                last_tok = jnp.where(bad, prev_last, last_tok)
+                done = jnp.where(bad, prev_done, done)
+                w = jnp.where(bad, 0, w)
             penult = jnp.where(emit, new_penult, penult)
             toks_k = jnp.full((b, k_out), -1, jnp.int32).at[:, 0].set(
                 jnp.where(emit, nxt, -1))
@@ -899,6 +926,7 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
     def body(carry, _):
         (cached, produced, last_tok, penult, done, poison, nonfinite, stats,
          rng, kpool, vpool, dkpool, dvpool) = carry
+        prev_last, prev_penult, prev_done = last_tok, penult, done
         # speculative frames are scheduled only when no slot prefills; a
         # prefilling row here would freeze (serve() never produces one)
         active = ~done & (cached >= prompt_lens) & (produced < limits)
@@ -962,8 +990,8 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         emit = (active[:, None] & (koffs[None, :] <= n_acc[:, None])
                 & (produced[:, None] + koffs[None, :] < limits[:, None])
                 & (eos_before == 0))
-        emit, done, nonfinite = _finite_check(tlogits, active, emit, done,
-                                              nonfinite)
+        emit, done, nonfinite, bad = _finite_check(tlogits, active, emit,
+                                                   done, nonfinite)
         m = jnp.sum(emit.astype(jnp.int32), axis=1)
         seq_toks = jnp.concatenate([last_tok[:, None], e], axis=1)
         new_last = jnp.take_along_axis(seq_toks, m[:, None], axis=1)[:, 0]
@@ -972,6 +1000,13 @@ def _spec_scan_body(fwd, params, prompts, prompt_lens, limits, eos_ids,
         last_tok = jnp.where(active, new_last, last_tok)
         penult = jnp.where(active, new_penult, penult)
         done = done | jnp.any(emit & is_eos, axis=1)
+        if repair:
+            # pre-step rollback (see _serving_scan_body); m is already 0
+            # for bad rows (their emit columns were cleared), so cached/
+            # produced stand still without an extra select
+            last_tok = jnp.where(bad, prev_last, last_tok)
+            penult = jnp.where(bad, prev_penult, penult)
+            done = jnp.where(bad, prev_done, done)
         # verify forwards == active rows (column 0 of the emit mask); the
         # accepted-draft count is the emit columns past it — the device-side
         # twin of the host arithmetic serve_stats always used

@@ -346,7 +346,7 @@ class DeviceSlotTable:
     # ---------------- frame execution + host replay ----------------
 
     def dispatch_frame(self, runner, params, kv, width: int, steps: int,
-                       greedy: bool, draft=None):
+                       greedy: bool, draft=None, repair=False):
         """Dispatch one K-step frame and swap the donated carry in place,
         returning the (tokens, emit) DEVICE arrays — no host transfer
         happens here (the telemetry transfer-guard test wraps exactly this
@@ -363,7 +363,7 @@ class DeviceSlotTable:
                 self.eos_ids, self.temps, self.tables, self.cached,
                 self.produced, self.last_tok, self.done, self.poison,
                 self.nonfinite, self.stats, self.rng, kv.k, kv.v,
-                width=width, steps=steps, greedy=greedy)
+                width=width, steps=steps, greedy=greedy, repair=repair)
             return toks, emit
         draft_runner, draft_params, draft_kv, gamma = draft
         (toks, emit, self.cached, self.produced, self.last_tok, self.penult,
@@ -374,17 +374,17 @@ class DeviceSlotTable:
             self.tables, self.cached, self.produced, self.last_tok,
             self.penult, self.done, self.poison, self.nonfinite, self.stats,
             self.rng, kv.k, kv.v, draft_kv.k, draft_kv.v, width=width,
-            steps=steps, greedy=greedy, gamma=gamma)
+            steps=steps, greedy=greedy, gamma=gamma, repair=repair)
         return toks, emit
 
     def run_frame(self, runner, params, kv, width: int, steps: int,
-                  greedy: bool, draft=None):
+                  greedy: bool, draft=None, repair=False):
         """Execute one K-step frame: dispatch, then fetch the
         (steps, B[, gamma+1]) token/emit pair — the only device→host
         transfer a frame performs (``stats_delta`` adds one more tiny
         frame-BOUNDARY read when telemetry is on)."""
         toks, emit = self.dispatch_frame(runner, params, kv, width, steps,
-                                         greedy, draft=draft)
+                                         greedy, draft=draft, repair=repair)
         return np.asarray(toks), np.asarray(emit)
 
     def set_poison(self, uids: List[int]) -> None:
@@ -398,6 +398,34 @@ class DeviceSlotTable:
             return
         idx = self._dev(jnp.asarray(rows, jnp.int32))
         self.poison = self.poison.at[idx].set(True)
+
+    def clear_nonfinite(self, uids: List[int]) -> None:
+        """Repair-policy boundary hook: the host decided these latched rows
+        get another chance — clear the finite-check latch AND the poison
+        flag (an injected fault is treated as a one-frame blip under
+        repair), one batched host→device write at the boundary. Unknown /
+        already-retired uids are ignored."""
+        rows = [self.slot_of_uid[u] for u in uids if u in self.slot_of_uid]
+        if not rows:
+            return
+        idx = self._dev(jnp.asarray(rows, jnp.int32))
+        self.poison = self.poison.at[idx].set(False)
+        self.nonfinite = self.nonfinite.at[idx].set(False)
+
+    def resync_committed(self, uids: List[int]) -> None:
+        """Re-read the device committed watermark for repaired rows. The
+        host replay (``absorb``) cannot see WHICH steps a repaired row
+        rolled back (the emit mask marks only that nothing was emitted), so
+        after a repair boundary its ``cached_h`` mirror may run ahead of the
+        device ``cached``; one tiny (B,) frame-boundary read — same budget
+        class as ``nonfinite_uids`` — truths it up. produced/done/emissions
+        are emit-mask-driven in the replay and never drift."""
+        rows = [self.slot_of_uid[u] for u in uids if u in self.slot_of_uid]
+        if not rows:
+            return
+        cached = np.asarray(self.cached)   # replicated under tp: full (B,)
+        for r in rows:
+            self.cached_h[r] = int(cached[r])
 
     def nonfinite_uids(self) -> List[int]:
         """Frame-boundary read of the in-graph finite-check latch: live

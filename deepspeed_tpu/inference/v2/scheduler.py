@@ -143,6 +143,16 @@ class Request:
     round0: int = 0            # boundary index at (re-)enqueue, for aging
     gen_base: int = 0
     preempts: int = 0
+    # re-admission metadata (router failover / crash resume): committed
+    # tokens this request carried INTO this engine, and whether it is a
+    # resume at all — a resumed request was already accepted once, so like
+    # a preempted one it is work the pressure loop must never shed (its
+    # quota bypass happens at submit; the flag protects it from
+    # slo_pressure sheds afterwards). The flag is separate from the token
+    # count because a QUEUED request migrating off a drained/killed
+    # replica resumes with zero committed tokens yet was still accepted.
+    resumed_from: int = 0
+    resumed: bool = False
 
 
 @dataclasses.dataclass
@@ -376,7 +386,8 @@ class RequestScheduler:
                 keep = deque()
                 while q:
                     r = q.popleft()
-                    if self._eff(r) == BEST_EFFORT and r.preempts == 0:
+                    if self._eff(r) == BEST_EFFORT and r.preempts == 0 \
+                            and not r.resumed:
                         self._queued_uids.discard(r.uid)
                         sheds.append(self._shed(r, "slo_pressure"))
                     else:

@@ -216,6 +216,11 @@ class ServingTelemetry:
         self.steps_trace_len = steps_trace_len
         self.monitor = None
         self.monitor_every = 1
+        # constant identity labels (engine=..., model=...) merged into
+        # EVERY exported ds_serving_* series — the router's per-replica
+        # metric identity. Lives OUTSIDE reset(): identity outlives serve
+        # runs. Empty (the default) keeps the exposition byte-identical.
+        self.base_labels: Dict[str, str] = {}
         # monitor step: monotonic across serve() runs (reset() zeroes the
         # per-serve frame counter, but an attached TensorBoard/CSV writer
         # must never see its step axis jump back to zero)
@@ -238,6 +243,7 @@ class ServingTelemetry:
                              # faults (kind-labeled), plus the per-kind
                              # headline counters the SLO dashboard plots
                              faults=0, quarantined=0, deadline_expired=0,
+                             nonfinite_repaired=0,
                              recoveries=0, frame_retries=0, slow_frames=0,
                              # KV memory hierarchy (kv_hierarchy.py):
                              # prefix-cache hit/publish/COW traffic and
@@ -310,6 +316,29 @@ class ServingTelemetry:
         flush — raise ``every_frames`` for high-frame-rate serving."""
         self.monitor = monitor
         self.monitor_every = max(1, every_frames)
+
+    def set_base_labels(self, **labels) -> None:
+        """Attach constant identity labels (``engine=``, ``model=``) to
+        every exported series — the per-replica identity a multi-engine
+        router stamps on each engine's telemetry so one scrape
+        distinguishes replicas. ``None`` values are dropped; calling with
+        no arguments clears nothing (pass ``engine=None`` explicitly to
+        unset a label)."""
+        for k, v in labels.items():
+            if v is None:
+                self.base_labels.pop(k, None)
+            else:
+                self.base_labels[k] = str(v)
+
+    def _labelstr(self, extra: str = "") -> str:
+        """Render ``{...}`` merging the base identity labels with
+        ``extra`` (a pre-rendered ``k="v",...`` fragment); empty when
+        neither exists, so label-free telemetry keeps the historical
+        exposition byte-for-byte."""
+        base = ",".join(f'{k}="{v}"'
+                        for k, v in sorted(self.base_labels.items()))
+        both = ",".join(s for s in (base, extra) if s)
+        return f"{{{both}}}" if both else ""
 
     # ------------------------------------------------------------------
     # request lifecycle (host side, called from serve())
@@ -441,6 +470,8 @@ class ServingTelemetry:
         self._inc_labeled("faults", (("kind", kind),))
         if kind == "poison_row":
             self.counters["quarantined"] += 1
+        elif kind == "nonfinite_repaired":
+            self.counters["nonfinite_repaired"] += 1
         elif kind == "deadline_expired":
             self.counters["deadline_expired"] += 1
         elif kind == "dispatch_retry":
@@ -725,29 +756,31 @@ class ServingTelemetry:
             f = float(v)
             return str(int(f)) if f == int(f) else repr(f)
 
+        lb = self._labelstr
         for name, val in self.counters.items():
             full = f"ds_serving_{name}_total"
             lines.append(f"# TYPE {full} counter")
-            lines.append(f"{full} {fmt(val)}")
+            lines.append(f"{full}{lb()} {fmt(val)}")
             # per-class/per-tenant scheduler labels share the family: one
             # TYPE line, unlabeled total first, labeled samples after
             for key, lval in sorted(self.labeled.get(name, {}).items()):
                 labels = ",".join(f'{k}="{v}"' for k, v in key)
-                lines.append(f"{full}{{{labels}}} {fmt(lval)}")
+                lines.append(f"{full}{lb(labels)} {fmt(lval)}")
         for name, val in self.gauges.items():
             full = f"ds_serving_{name}"
             lines.append(f"# TYPE {full} gauge")
-            lines.append(f"{full} {fmt(val)}")
+            lines.append(f"{full}{lb()} {fmt(val)}")
         if self.class_ttft:
             full = "ds_serving_class_ttft_p90_seconds"
             lines.append(f"# TYPE {full} gauge")
             for cls in sorted(self.class_ttft):
                 q = self.class_ttft[cls].percentile(90)
                 if q is not None:
-                    lines.append(f'{full}{{class="{cls}"}} {q:g}')
+                    extra = f'class="{cls}"'
+                    lines.append(f"{full}{lb(extra)} {q:g}")
         ar = self.serve_view["spec"]["acceptance_rate"]
         lines.append("# TYPE ds_serving_spec_acceptance_rate gauge")
-        lines.append("ds_serving_spec_acceptance_rate "
+        lines.append(f"ds_serving_spec_acceptance_rate{lb()} "
                      f"{fmt(ar) if ar is not None else 'NaN'}")
         for name, h in self.hists.items():
             full = f"ds_serving_{name}_seconds"
@@ -755,15 +788,17 @@ class ServingTelemetry:
             cum = 0
             for bound, cnt in zip(h.bounds, h.counts[:-1]):
                 cum += int(cnt)
-                lines.append(f'{full}_bucket{{le="{bound:g}"}} {cum}')
-            lines.append(f'{full}_bucket{{le="+Inf"}} {h.total}')
-            lines.append(f"{full}_sum {h.sum:g}")
-            lines.append(f"{full}_count {h.total}")
+                extra = f'le="{bound:g}"'
+                lines.append(f"{full}_bucket{lb(extra)} {cum}")
+            extra = 'le="+Inf"'
+            lines.append(f"{full}_bucket{lb(extra)} {h.total}")
+            lines.append(f"{full}_sum{lb()} {h.sum:g}")
+            lines.append(f"{full}_count{lb()} {h.total}")
             for p in (50, 90, 99):
                 q = h.percentile(p)
                 if q is not None:
-                    lines.append(
-                        f'{full}_quantile{{quantile="0.{p}"}} {q:g}')
+                    extra = f'quantile="0.{p}"'
+                    lines.append(f"{full}_quantile{lb(extra)} {q:g}")
         return "\n".join(lines) + "\n"
 
     def serve_metrics_http(self, port: int = 0, host: str = "127.0.0.1"):

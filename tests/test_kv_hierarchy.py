@@ -173,6 +173,54 @@ def test_prefix_cache_block_spill_and_restore(tmp_path):
     assert kv.allocator.free_blocks == 7
 
 
+def test_batched_pressure_spill_io_counts(tmp_path, monkeypatch):
+    """``reclaim`` spills N cold blocks as ONE batch: one device gather
+    per pool (``read_pages`` on the whole block list), all page writes
+    committed by a single swapper ``wait``, and one index rewrite — the
+    per-block path paid each of those N times (ROADMAP item 3(a))."""
+    kv = _tiny_pool()
+    tier = KVSwapTier(str(tmp_path))
+    pc = PrefixCache(kv, swap=tier)
+    n = 3
+    blocks = kv.allocator.allocate(n)
+    content = np.arange(2 * 2 * n * 4 * 4, dtype=np.float32).reshape(
+        2, 2, n, 4, 4)
+    kv.k = kv.k.at[:, :, blocks].set(content)
+    kv.v = kv.v.at[:, :, blocks].set(-content)
+    stream = list(range(4 * n))
+    pc.publish(uid=1, stream=stream, blocks=blocks, upto_tokens=4 * n)
+    kv.allocator.free(blocks)          # the cache refs are now the only ones
+    counts = {"gather": 0, "wait": 0, "index": 0}
+    orig_read = type(kv).read_pages
+    monkeypatch.setattr(type(kv), "read_pages",
+                        lambda self, ids: (counts.__setitem__(
+                            "gather", counts["gather"] + 1),
+                            orig_read(self, ids))[1])
+    orig_wait = tier.swapper.wait
+    monkeypatch.setattr(tier.swapper, "wait",
+                        lambda: (counts.__setitem__(
+                            "wait", counts["wait"] + 1), orig_wait())[1])
+    orig_save = tier._save_index
+    monkeypatch.setattr(tier, "_save_index",
+                        lambda: (counts.__setitem__(
+                            "index", counts["index"] + 1), orig_save())[1])
+    assert pc.reclaim(n) == n
+    assert counts == {"gather": 1, "wait": 1, "index": 1}, counts
+    assert pc.resident_blocks() == 0
+    assert tier.stats["blocks_out"] == n
+    assert pc.stats["swapped_out"] == n
+    # the spilled entries stay matchable and restore bit-identically
+    full, _ = pc.match(stream + [99])
+    assert len(full) == n and all(e.block is None for e in full)
+    assert all(pc.ensure_resident(e, protect={x.eid for x in full})
+               for e in full)
+    order = [e.block for e in full]
+    np.testing.assert_array_equal(np.asarray(kv.k[:, :, order]), content)
+    np.testing.assert_array_equal(np.asarray(kv.v[:, :, order]), -content)
+    pc.clear()
+    assert kv.allocator.free_blocks == 7
+
+
 # ---------------------------------------------------------------------------
 # serving parity: prefix cache on vs off
 # ---------------------------------------------------------------------------

@@ -1,0 +1,646 @@
+"""Multi-engine router chaos suite: placement, failover, drain.
+
+Deterministic throughout — placement is consistent hashing + a pure
+least-loaded score with name tie-breaks, failure is driven by the scripted
+``RouterFaultInjector`` (tick-keyed, no wall clocks), and backoffs are in
+router ticks — so every scenario pins exact outputs:
+
+* kill-one-of-two mid-stream: every accepted request completes and greedy
+  outputs are token-identical to the no-failure run (the failed engine's
+  snapshot splits per-request and re-admits on the healthy peer as resume
+  arrivals), including across heterogeneous TP degrees (tp=1 <-> tp=8);
+* graceful drain: placement stops, live rows finish, the held queue
+  migrates via snapshot — token parity again;
+* a flapping replica is quarantined with exponential tick backoff and
+  bounded per-request re-routes: capacity degrades, availability does not;
+* affinity stickiness and least-loaded placement determinism.
+
+Engines are module-scoped and REUSED across router instances (a completed
+or failed-over serve leaves the engine clean — the abandonment/ledger
+cleanup contract the fault suite pins), so the suite compiles each frame
+program once.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig,
+                                                  ServeBoundary)
+from deepspeed_tpu.inference.v2.faults import (RouterFaultInjector,
+                                               RouterFaultSpec,
+                                               snapshot_split)
+from deepspeed_tpu.inference.v2.router import (CLOSED, DEAD, DRAINED,
+                                               HEALTHY, QUARANTINED,
+                                               EngineRouter, RouterConfig,
+                                               placement_score)
+from deepspeed_tpu.inference.v2.scheduler import RequestScheduler
+from deepspeed_tpu.models import build_model
+
+pytestmark = pytest.mark.chaos
+
+MAX_NEW = 8
+
+
+@pytest.fixture(autouse=True)
+def _mesh(mesh_8dp):
+    yield
+
+
+@pytest.fixture(scope="module")
+def tiny_model_params():
+    # 8 heads: the tp=8 replica's sharded axes divide the virtual mesh
+    model = build_model("tiny", num_heads=8)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, max_seq_len=128, **over):
+    kw = dict(kv_block_size=16, prefill_chunk_size=16, max_tokens_per_step=256,
+              dtype="float32", max_ragged_batch_size=8, frame_steps=4,
+              frame_retry_backoff_s=0.0)
+    kw.update(over)
+    return InferenceEngineV2(model, RaggedInferenceEngineConfig(**kw),
+                             params=params, max_seq_len=max_seq_len)
+
+
+@pytest.fixture(scope="module")
+def engine_pool(tiny_model_params):
+    """Module-scoped engines, reused across routers (compile once)."""
+    model, params = tiny_model_params
+    return {
+        "a": _engine(model, params),
+        "b": _engine(model, params),
+        "tp8": _engine(model, params, tp=8),
+    }
+
+
+PROMPTS = {u: np.random.default_rng(5).integers(0, 200, (200,))
+           .astype(np.int32)[o:o + n]
+           for u, (o, n) in enumerate(((0, 7), (10, 24), (40, 33), (80, 5),
+                                       (120, 18), (150, 11)))}
+SCHEDULE = {0: [0, 1], 2: [2], 3: [3], 4: [4, 5]}
+
+
+def _arrivals(schedule=None, session=None, max_new=None):
+    schedule = SCHEDULE if schedule is None else schedule
+    for k in range(max(schedule) + 2):
+        batch = []
+        for u in schedule.get(k, []):
+            if session is None:
+                batch.append((u, PROMPTS[u]))
+            else:
+                item = {"uid": u, "tokens": PROMPTS[u], "session": session}
+                if max_new is not None:
+                    item["max_new_tokens"] = max_new
+                batch.append(item)
+        yield batch
+
+
+@pytest.fixture(scope="module")
+def greedy_base(engine_pool):
+    """Single-engine no-failure outputs — THE reference every router
+    scenario's completions must match token-for-token."""
+    return dict(engine_pool["a"].serve(_arrivals(), max_new_tokens=MAX_NEW))
+
+
+def _assert_clean(eng):
+    assert eng.kv.free_blocks == eng.kv.num_blocks - 1
+    assert not eng.state.seqs
+    assert not eng._ledger
+
+
+def _assert_parity(outs, base, uids=None):
+    uids = set(base) if uids is None else set(uids)
+    assert set(outs) >= uids
+    for u in uids:
+        assert np.array_equal(outs[u], base[u]), \
+            f"uid={u}: {outs[u]} != {base[u]}"
+
+
+# ---------------------------------------------------------------------------
+# placement units (no engines served)
+# ---------------------------------------------------------------------------
+
+
+def test_placement_score_pure_and_monotone():
+    idle = placement_score(0, 0, 8, 1.0, None, 1000.0)
+    busy = placement_score(4, 8, 8, 0.2, 1500.0, 1000.0)
+    assert idle < busy
+    # deterministic: same inputs, same score
+    assert busy == placement_score(4, 8, 8, 0.2, 1500.0, 1000.0)
+
+
+def test_least_loaded_placement_determinism(engine_pool):
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    # equal load: tie breaks by name, repeatably
+    assert all(router._least_loaded(
+        {n: router._replicas[n] for n in ("a", "b")}) == "a"
+        for _ in range(5))
+    # loading a's feed flips the choice
+    router._replicas["a"].feed.extend([(90, PROMPTS[0]), (91, PROMPTS[1])])
+    assert router._least_loaded(
+        {n: router._replicas[n] for n in ("a", "b")}) == "b"
+    router._replicas["a"].feed.clear()
+
+
+def test_affinity_stickiness(engine_pool):
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    # one session key always lands on the same replica
+    picks = {router._pick("session-42") for _ in range(10)}
+    assert len(picks) == 1
+    # the keyspace as a whole spreads over both replicas
+    spread = {router._pick(f"s{i}") for i in range(64)}
+    assert spread == {"a", "b"}
+    # a quarantined affinity target falls over to the healthy peer,
+    # deterministically
+    target = router._pick("session-42")
+    router._replicas[target].status = QUARANTINED
+    other = ({"a", "b"} - {target}).pop()
+    assert router._pick("session-42") == other
+    router._replicas[target].status = HEALTHY
+
+
+def test_heartbeat_threshold_unit(engine_pool):
+    # the gap charged to a replica is its OWN frame time (boundary t minus
+    # the step start the router recorded), NOT boundary-to-boundary wall
+    # clock — in the serial stepping loop the latter would include every
+    # peer's frame time and a single slow replica would cascade the whole
+    # fleet into quarantine
+    cfg = RouterConfig(heartbeat_timeout_s=1.0, max_missed_heartbeats=2)
+    router = EngineRouter({"a": engine_pool["a"]}, cfg)
+    r = router._replicas["a"]
+
+    def beat(step_t0, t, dispatched=True):
+        return router._note_heartbeat(r, ServeBoundary(
+            index=0, dispatched=dispatched, live=1, queued=0, free_slots=7,
+            t=t), tick=0, step_t0=step_t0)
+
+    assert beat(0.0, 0.5) is None        # own frame within budget
+    assert beat(2.0, 4.0) is None        # miss 1 (2s own frame)
+    assert r.missed_heartbeats == 1
+    assert beat(4.0, 4.5) is None        # healthy frame resets
+    assert r.missed_heartbeats == 0
+    beat(5.0, 7.0)                       # miss 1
+    detail = beat(7.0, 9.0)              # miss 2 -> threshold
+    assert detail is not None and "heartbeat" in detail
+    assert router.counters["heartbeat_misses"] == 3
+    # a slow PEER tick between this replica's boundaries never counts:
+    # 10s elapse before the router steps it again, but its own frame is
+    # fast — no miss, and the consecutive-miss counter resets
+    r.missed_heartbeats = 1
+    assert beat(19.0, 19.2) is None
+    assert r.missed_heartbeats == 0
+    # missing step_t0 (first step after construction/rejoin) never counts
+    assert beat(None, 99.0) is None
+    assert r.missed_heartbeats == 0
+
+
+def test_snapshot_split_resume_arrivals():
+    snap = {"version": 1, "requests": [
+        {"uid": 7, "prompt": [1, 2, 3], "generated": [9, 8], "limit": 6,
+         "temp": 0.0, "eos": None, "deadline_remaining_ms": 0.0,
+         "tenant": "t0", "priority": "batch", "slo_ms": None,
+         "swapped_tokens": None},
+    ]}
+    (item,) = snapshot_split(snap)
+    assert item["uid"] == 7 and item["generated"] == [9, 8]
+    assert item["max_new_tokens"] == 6 and item["tokens"] == [1, 2, 3]
+    assert item["eos_token_id"] == -1          # resolved no-EOS, explicit
+    assert item["deadline_ms"] > 0             # expired -> epsilon, not None
+    assert item["tenant"] == "t0" and item["priority"] == "batch"
+    with pytest.raises(ValueError, match="version"):
+        snapshot_split({"version": 2})
+
+
+def test_router_fault_spec_validation():
+    with pytest.raises(ValueError, match="unknown router fault kind"):
+        RouterFaultSpec(kind="meteor", tick=0, engine="a")
+    with pytest.raises(ValueError, match="tick"):
+        RouterFaultSpec(kind="engine_kill", tick=-1, engine="a")
+
+
+# ---------------------------------------------------------------------------
+# serving scenarios
+# ---------------------------------------------------------------------------
+
+
+def test_router_no_failure_parity(engine_pool, greedy_base):
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    outs = dict(router.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    _assert_parity(outs, greedy_base)
+    st = router.stats()
+    assert st["counters"]["placements"] == len(PROMPTS)
+    assert st["counters"]["failovers"] == 0
+    assert st["counters"]["completions"] == len(PROMPTS)
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_kill_one_of_two_midstream_parity(engine_pool, greedy_base):
+    """The acceptance scenario: two replicas, all requests pinned to one by
+    session affinity, that replica killed mid-stream — every request
+    completes on the survivor, token-identical to the no-failure run."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]},
+                          RouterConfig(quarantine_backoff_ticks=64))
+    victim = router._pick("pinned")
+    survivor = ({"a", "b"} - {victim}).pop()
+    inj = RouterFaultInjector(
+        [{"kind": "engine_kill", "tick": 3, "engine": victim}])
+    outs = dict(router.serve(_arrivals(session="pinned"),
+                             max_new_tokens=MAX_NEW, faults=inj))
+    _assert_parity(outs, greedy_base)
+    st = router.stats()
+    assert st["counters"]["engine_kills"] == 1
+    assert st["counters"]["failovers"] == 1
+    assert st["counters"]["reroutes"] >= 1
+    assert st["counters"]["requests_failed"] == 0
+    assert st["replicas"][victim] == QUARANTINED
+    assert st["replicas"][survivor] in (HEALTHY, CLOSED)
+    assert router.last_recovery_ms >= 0.0
+    assert any(f.kind == "engine_kill" for f in router.fault_log)
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+@pytest.mark.multichip
+def test_kill_heterogeneous_tp_parity(engine_pool, greedy_base):
+    """Failover ACROSS TP degrees: everything pinned to the tp=8 replica,
+    which is killed mid-stream; the tp=1 peer resumes every in-flight
+    request token-identically (the snapshot is engine-shape-agnostic), and
+    vice versa is covered by the snapshot resume tests in
+    tests/test_serving_tp.py."""
+    router = EngineRouter({"a": engine_pool["a"], "tp8": engine_pool["tp8"]},
+                          RouterConfig(quarantine_backoff_ticks=64))
+    # pin to the tp=8 replica regardless of ring layout: find a session
+    # key that hashes onto it (deterministic search)
+    key = next(f"sess{i}" for i in range(256)
+               if router._pick(f"sess{i}") == "tp8")
+    inj = RouterFaultInjector(
+        [{"kind": "engine_kill", "tick": 3, "engine": "tp8"}])
+    outs = dict(router.serve(_arrivals(session=key),
+                             max_new_tokens=MAX_NEW, faults=inj))
+    _assert_parity(outs, greedy_base)
+    assert router.stats()["replicas"]["tp8"] == QUARANTINED
+    assert router.stats()["counters"]["requests_failed"] == 0
+
+
+def test_drain_and_migrate_parity(engine_pool, greedy_base):
+    """Planned removal: the pinned replica drains mid-stream — placement
+    stops, live rows finish there, the held queue migrates to the peer via
+    snapshot_split — and outputs stay token-identical. frame_slots=2 keeps
+    a queue behind the live rows so the migration path actually carries
+    requests."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    victim = router._pick("pinned")
+    # four pinned arrivals up front against frame_slots=2: two go live,
+    # the rest are QUEUED on the victim when the drain starts at tick 1
+    inj = RouterFaultInjector(
+        [{"kind": "engine_drain", "tick": 1, "engine": victim}])
+    outs = dict(router.serve(
+        _arrivals(schedule={0: [0, 1, 2, 3], 4: [4, 5]}, session="pinned"),
+        max_new_tokens=MAX_NEW, faults=inj,
+        engine_kwargs={"frame_slots": 2}))
+    _assert_parity(outs, greedy_base)
+    st = router.stats()
+    assert st["counters"]["drains"] == 1
+    assert st["counters"]["drain_migrated"] >= 1   # the queue MOVED
+    assert st["counters"]["failovers"] == 0
+    assert st["replicas"][victim] == DRAINED
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_flapping_replica_bounded_retry(engine_pool, greedy_base):
+    """A replica that dies every time it rejoins degrades CAPACITY, not
+    availability: every request still completes (on the healthy peer),
+    re-routes stay bounded, and the flapper ends DEAD after its strike
+    budget."""
+    cfg = RouterConfig(quarantine_backoff_ticks=2, max_engine_failures=1)
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]},
+                          cfg)
+    victim = router._pick("pinned")
+    # kill at 1; rejoin at 3 (backoff 2); second kill at 5 exceeds the
+    # one-failure strike budget -> DEAD, deterministically
+    inj = RouterFaultInjector(
+        [{"kind": "engine_kill", "tick": t, "engine": victim}
+         for t in (1, 5)])
+    outs = dict(router.serve(_arrivals(session="pinned"),
+                             max_new_tokens=MAX_NEW, faults=inj))
+    _assert_parity(outs, greedy_base)
+    st = router.stats()
+    assert st["counters"]["requests_failed"] == 0
+    assert st["counters"]["rejoins"] >= 1
+    assert st["replicas"][victim] == DEAD
+    # kills only fire while the replica is up; every one that fired is a
+    # failover, and the strike budget caps the damage
+    assert st["counters"]["failovers"] == st["counters"]["engine_kills"]
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_reroute_budget_exhausts_to_failed_request(engine_pool):
+    """Kill BOTH replicas while one long request is in flight: the second
+    failover exceeds max_reroute_retries=1, the request is failed loudly
+    (router fault log + counter) instead of looping forever."""
+    cfg = RouterConfig(max_reroute_retries=1, quarantine_backoff_ticks=64)
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]},
+                          cfg)
+    first = router._pick("pinned")
+    second = ({"a", "b"} - {first}).pop()
+    inj = RouterFaultInjector([
+        {"kind": "engine_kill", "tick": 2, "engine": first},
+        {"kind": "engine_kill", "tick": 5, "engine": second},
+    ])
+    outs = dict(router.serve(
+        iter([[{"uid": 0, "tokens": PROMPTS[1], "session": "pinned",
+                "max_new_tokens": 64}]]),
+        max_new_tokens=64, faults=inj))
+    assert outs == {}
+    st = router.stats()
+    assert st["counters"]["requests_failed"] == 1
+    assert any(f.kind == "request_failed" and f.uid == 0
+               for f in router.fault_log)
+    assert st["in_flight"] == 0
+
+
+def test_scheduler_path_failover_parity(engine_pool, greedy_base):
+    """Kill-and-failover with a RequestScheduler per replica: resume
+    arrivals re-enter through sched.submit(bypass_quota=True) and outputs
+    stay token-identical."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    victim = router._pick("pinned")
+    inj = RouterFaultInjector(
+        [{"kind": "engine_kill", "tick": 3, "engine": victim}])
+    outs = dict(router.serve(_arrivals(session="pinned"),
+                             max_new_tokens=MAX_NEW, faults=inj,
+                             scheduler_factory=RequestScheduler))
+    _assert_parity(outs, greedy_base)
+    assert router.stats()["counters"]["requests_failed"] == 0
+
+
+# ---------------------------------------------------------------------------
+# engine-level router hooks
+# ---------------------------------------------------------------------------
+
+
+def test_boundary_events_parity(engine_pool, greedy_base):
+    eng = engine_pool["a"]
+    outs, events = {}, []
+    for item in eng.serve(_arrivals(), max_new_tokens=MAX_NEW,
+                          yield_boundaries=True):
+        if isinstance(item, ServeBoundary):
+            events.append(item)
+        else:
+            outs[item[0]] = item[1]
+    _assert_parity(outs, greedy_base)
+    assert events and all(e.index >= 0 for e in events)
+    assert events[-1].live == 0
+    # the boundary clock is monotonic and ends drained
+    assert all(a.index < b.index for a, b in zip(events, events[1:]))
+
+
+def test_resume_arrival_midrun_parity(engine_pool, greedy_base):
+    """A dict arrival carrying ``generated`` resumes mid-run: committed
+    tokens fold into the re-prefill and the completion equals the
+    uninterrupted run (the failover currency, tested without a router)."""
+    eng = engine_pool["a"]
+    base = greedy_base[1]
+    item = {"uid": 1, "tokens": PROMPTS[1], "generated": [int(t) for t in
+                                                          base[:3]],
+            "max_new_tokens": MAX_NEW}
+    outs = dict(eng.serve(iter([[item]]), max_new_tokens=MAX_NEW))
+    assert np.array_equal(outs[1], base)
+    _assert_clean(eng)
+    # already-complete resume yields immediately
+    done = {"uid": 2, "tokens": PROMPTS[1],
+            "generated": [int(t) for t in base],
+            "max_new_tokens": MAX_NEW}
+    outs2 = dict(eng.serve(iter([[done]]), max_new_tokens=MAX_NEW))
+    assert np.array_equal(outs2[2], base)
+    _assert_clean(eng)
+
+
+def test_engine_drain_holds_queue(engine_pool, greedy_base):
+    """begin_drain() stops admission at the next boundary while live rows
+    finish; the held queue is exactly the ledger, and end_drain() releases
+    it."""
+    eng = engine_pool["a"]
+    gen = eng.serve(_arrivals(schedule={0: [0, 1, 2]},
+                              max_new=None, session="s"),
+                    max_new_tokens=MAX_NEW, frame_slots=2,
+                    yield_boundaries=True)
+    outs = {}
+    drained_at = None
+    for item in gen:
+        if isinstance(item, ServeBoundary):
+            if item.index == 1 and drained_at is None:
+                eng.begin_drain()
+                drained_at = item.index
+            if drained_at is not None and item.live == 0 and item.queued:
+                # live rows done, queue held: snapshot == the queue
+                snap = eng.snapshot_serving_state()
+                assert {r["uid"] for r in snap["requests"]} == {2}
+                assert snap["requests"][0]["generated"] == []
+                eng.end_drain()
+        else:
+            outs[item[0]] = item[1]
+    _assert_parity(outs, greedy_base, uids=[0, 1, 2])
+    assert drained_at is not None
+    _assert_clean(eng)
+
+
+def test_router_prometheus_exposition(engine_pool):
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]},
+                          model_labels={"a": "tiny", "b": "tiny"})
+    dict(router.serve(_arrivals(schedule={0: [0]}), max_new_tokens=4))
+    text = router.render_prometheus()
+    assert "# TYPE ds_router_placements_total counter" in text
+    assert 'ds_router_placements_total{engine="a"}' in text
+    assert 'ds_router_replica_up{engine="a"} 1' in text
+    # per-replica serving series carry the engine/model identity labels
+    assert 'ds_serving_frames_total{engine="a",model="tiny"}' in text \
+        or 'ds_serving_frames_total{engine="b",model="tiny"}' in text
+    # scheduler-style labels merge AFTER the identity labels
+    assert "ds_serving_ttft_seconds_bucket{engine=" in text
+    # ONE # TYPE line per metric family across the whole fleet, with every
+    # replica's samples grouped under it (the exposition format requires
+    # all lines of one metric in a single group — duplicated headers or
+    # interleaved families make a strict scraper reject the payload)
+    type_lines = [l for l in text.splitlines() if l.startswith("# TYPE ")]
+    assert len(type_lines) == len(set(type_lines))
+    blocks = [b for b in text.split("# TYPE ")
+              if b.startswith("ds_serving_frames_total ")]
+    (frames_block,) = blocks      # one block holds BOTH replicas' samples
+    assert 'engine="a"' in frames_block and 'engine="b"' in frames_block
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        eng.telemetry.set_base_labels(engine=None, model=None)
+
+
+def test_engine_side_retirement_does_not_hang_router(engine_pool):
+    """Engines retire some requests WITHOUT yielding them (deadline
+    expiry here; poison quarantine and scheduler sheds take the same
+    path): the router must reconcile those assignments — not spin forever
+    waiting for a completion that can never come."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    outs = dict(router.serve(
+        iter([[{"uid": 0, "tokens": PROMPTS[1], "deadline_ms": 1e-3},
+               {"uid": 1, "tokens": PROMPTS[2]}]]),
+        max_new_tokens=MAX_NEW))
+    assert 0 not in outs and 1 in outs       # expired dropped, peer fine
+    st = router.stats()
+    assert st["counters"]["engine_retired"] == 1
+    assert st["in_flight"] == 0
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_all_replicas_drained_raises(engine_pool):
+    """Draining EVERY replica while arrivals keep coming is an operator
+    error the router surfaces loudly — terminal-state replicas never
+    accept again, so unplaceable work must not cycle silently forever."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    inj = RouterFaultInjector(
+        [{"kind": "engine_drain", "tick": 0, "engine": "a"},
+         {"kind": "engine_drain", "tick": 0, "engine": "b"}])
+    with pytest.raises(RuntimeError, match="drained"):
+        list(router.serve(
+            _arrivals(schedule={0: [0], 4: [1]}, session="pinned"),
+            max_new_tokens=MAX_NEW, faults=inj))
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        eng.end_drain()
+        _assert_clean(eng)
+
+
+def test_abandoned_router_serve_cleans_up(engine_pool, greedy_base):
+    """Breaking out of router.serve() mid-stream must close every replica
+    generator (running the engines' own cleanup) and leave the router
+    reusable — a second serve starts fresh generators with its own
+    parameters."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    gen = router.serve(_arrivals(), max_new_tokens=MAX_NEW)
+    next(gen)               # at least one completion, then walk away
+    gen.close()
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)  # engine serve finally-blocks ran
+    assert all(r.gen is None for r in router._replicas.values())
+    outs = dict(router.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    _assert_parity(outs, greedy_base)
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_drain_intent_survives_midDrain_failure(engine_pool, greedy_base):
+    """A replica killed WHILE draining must not rejoin as an accepting
+    replica — the operator's decommission intent is re-armed, so after the
+    quarantine backoff it drains (empty) instead of taking placements."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]},
+                          RouterConfig(quarantine_backoff_ticks=2))
+    victim = router._pick("pinned")
+    inj = RouterFaultInjector(
+        [{"kind": "engine_drain", "tick": 1, "engine": victim},
+         {"kind": "engine_kill", "tick": 2, "engine": victim}])
+    outs = dict(router.serve(
+        _arrivals(schedule={0: [0, 1, 2, 3], 8: [4, 5]}, session="pinned"),
+        max_new_tokens=MAX_NEW, faults=inj,
+        engine_kwargs={"frame_slots": 2}))
+    _assert_parity(outs, greedy_base)
+    st = router.stats()
+    assert st["counters"]["requests_failed"] == 0
+    # the rejoined replica drained instead of re-entering rotation
+    assert st["replicas"][victim] == DRAINED
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_resume_truncated_fault_recorded(tiny_model_params):
+    """A failover resume landing on a peer whose max_seq_len cannot hold
+    the original budget is recorded loudly (resume_truncated fault) — the
+    shortened output must not pass as a normal completion."""
+    model, params = tiny_model_params
+    small = _engine(model, params, )
+    small.max_seq_len = 48            # peer with a smaller context window
+    small.telemetry.reset()
+    small.fault_log.clear()
+    item = {"uid": 0, "tokens": PROMPTS[2], "generated": [1, 2],
+            "max_new_tokens": 32}     # 33 prompt + 32 + 1 > 48
+    outs = dict(small.serve(iter([[item]]), max_new_tokens=32))
+    assert 0 in outs                  # serves what fits...
+    assert any(f.kind == "resume_truncated" and f.uid == 0
+               for f in small.fault_log)   # ...but says so
+
+
+def test_unservable_prompt_fails_loudly_not_fleetwide(tiny_model_params):
+    """Placement screens prompt size against each replica's max_seq_len:
+    a long prompt never lands on a too-small heterogeneous peer (where
+    arrival validation would hard-raise INSIDE its serve generator and
+    tear the whole fleet serve down), and when the only replica that
+    could hold it dies for good, the request fails loudly
+    (requests_failed) while everything else keeps completing."""
+    model, params = tiny_model_params
+    small = _engine(model, params, max_seq_len=32)   # 33-tok prompt: never
+    big = _engine(model, params)
+    router = EngineRouter({"big": big, "small": small},
+                          RouterConfig(rejoin=False))
+    key = next(f"s{i}" for i in range(256)
+               if router._pick(f"s{i}") == "big")
+    inj = RouterFaultInjector([{"kind": "engine_kill", "tick": 1,
+                                "engine": "big"}])
+    outs = dict(router.serve(
+        iter([[{"uid": 2, "tokens": PROMPTS[2], "session": key},
+               {"uid": 3, "tokens": PROMPTS[3], "session": key}]]),
+        max_new_tokens=MAX_NEW, faults=inj))
+    st = router.stats()
+    # uid 2 (33-token prompt) could only ever run on the dead replica
+    assert 2 not in outs
+    assert st["counters"]["requests_failed"] == 1
+    assert any(f.kind == "request_failed" and f.uid == 2
+               for f in router.fault_log)
+    assert st["replicas"]["big"] == DEAD
+    # uid 3 failed over to the small peer, token-identical
+    solo = dict(_engine(model, params).serve(
+        iter([[(3, PROMPTS[3])]]), max_new_tokens=MAX_NEW))
+    assert np.array_equal(outs[3], solo[3])
+    _assert_clean(small)
+
+
+def test_router_serve_resets_stale_state(engine_pool, greedy_base):
+    """serve() is re-entrant: per-request routing state parked by an
+    earlier (abandoned) serve — orphaned failover resumes in
+    _deferred/_unplaced, assignments, re-route budgets — must not leak
+    ghost requests into the next call, and a quarantined replica's
+    rejoin tick (relative to the PREVIOUS run's tick clock) is re-armed
+    on the new one."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]},
+                          RouterConfig(quarantine_backoff_ticks=2))
+    ghost = {"uid": 99, "tokens": PROMPTS[0], "generated": [5],
+             "max_new_tokens": MAX_NEW}
+    router._deferred.append((7, ghost, frozenset(("a",))))
+    router._unplaced.append((dict(ghost, uid=98), frozenset()))
+    router._assignment[99] = "a"
+    router._reroute_hops[99] = 2
+    ra = router._replicas["a"]
+    ra.status = QUARANTINED
+    ra.failures = 1
+    ra.rejoin_tick = 500          # stale: relative to a dead tick clock
+    outs = dict(router.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    assert set(outs) == set(greedy_base)       # no ghost uids 98/99
+    _assert_parity(outs, greedy_base)
+    assert router.replica_status()["a"] == HEALTHY   # re-armed, rejoined
+    assert not router._deferred and not router._unplaced
+    assert 99 not in router._reroute_hops
+    for eng in (engine_pool["a"], engine_pool["b"]):
+        _assert_clean(eng)
+
+
+def test_transfer_guard_router_failover(engine_pool, frame_transfer_guard,
+                                        greedy_base):
+    """Routing, failover, and resume re-admission are frame-BOUNDARY work:
+    the in-frame device->host transfer guard stays green through a kill."""
+    router = EngineRouter({"a": engine_pool["a"], "b": engine_pool["b"]})
+    victim = router._pick("pinned")
+    inj = RouterFaultInjector(
+        [{"kind": "engine_kill", "tick": 3, "engine": victim}])
+    outs = dict(router.serve(_arrivals(session="pinned"),
+                             max_new_tokens=MAX_NEW, faults=inj))
+    _assert_parity(outs, greedy_base)

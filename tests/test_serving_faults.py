@@ -521,3 +521,95 @@ def test_fault_log_is_bounded(tiny_model_params):
     model, params = tiny_model_params
     e = _engine(model, params, fault_log_max=4)
     assert e.fault_log.maxlen == 4
+
+
+# ---------------------------------------------------------------------------
+# nonfinite_policy="repair": in-graph NaN repair (pre-fault-carry rollback)
+# ---------------------------------------------------------------------------
+
+
+def test_nonfinite_policy_validation(tiny_model_params):
+    model, params = tiny_model_params
+    with pytest.raises(ValueError, match="nonfinite_policy"):
+        _engine(model, params, nonfinite_policy="hope")
+    with pytest.raises(ValueError, match="nonfinite_repair_limit"):
+        _engine(model, params, nonfinite_policy="repair",
+                nonfinite_repair_limit=0)
+
+
+@pytest.fixture(scope="module")
+def repair_engine(tiny_model_params):
+    model, params = tiny_model_params
+    return _engine(model, params, nonfinite_policy="repair",
+                   nonfinite_repair_limit=2)
+
+
+def test_nonfinite_repair_transient_blip_parity(repair_engine,
+                                                fault_free_base):
+    """A one-frame poison blip under repair: the row rolls back to its
+    pre-fault carry in-graph and CONTINUES — every request, including the
+    poisoned one, finishes token-identical to the fault-free run (the
+    quarantine policy retires the victim instead)."""
+    e = repair_engine
+    inj = FaultInjector([{"kind": "poison_row", "frame": 1, "uid": 1}])
+    outs = dict(e.serve(_arrivals(), max_new_tokens=8, faults=inj))
+    assert inj.fired
+    assert set(outs) == set(fault_free_base)
+    for u, base in fault_free_base.items():
+        assert np.array_equal(outs[u], base), f"uid={u}"
+    kinds = [f.kind for f in e.fault_log]
+    assert "nonfinite_repaired" in kinds
+    assert "poison_row" not in kinds
+    assert e.telemetry.counters["nonfinite_repaired"] >= 1
+    assert e.telemetry.counters["quarantined"] == 0
+    _assert_clean(e)
+
+
+def test_nonfinite_repair_escalates_persistent_fault(repair_engine,
+                                                     fault_free_base):
+    """A fault that latches nonfinite_repair_limit consecutive boundaries
+    is not a blip: the row escalates to the quarantine path, siblings
+    stay token-identical."""
+    e = repair_engine
+    e.fault_log.clear()          # the log is engine-lifetime, not per-serve
+    inj = FaultInjector([{"kind": "poison_row", "frame": f, "uid": 1}
+                         for f in (1, 2, 3, 4, 5)])
+    outs = dict(e.serve(_arrivals(), max_new_tokens=8, faults=inj))
+    assert 1 not in outs
+    for u, base in fault_free_base.items():
+        if u != 1:
+            assert np.array_equal(outs[u], base), f"uid={u}"
+    kinds = [f.kind for f in e.fault_log]
+    assert kinds.count("nonfinite_repaired") == 2     # the repair budget
+    assert kinds.count("poison_row") == 1             # then escalation
+    assert kinds.index("poison_row") > kinds.index("nonfinite_repaired")
+    _assert_clean(e)
+
+
+def test_nonfinite_repair_speculative_parity(tiny_model_params,
+                                             fault_free_base):
+    """The rollback selects ride the SPECULATIVE frame carry too: a blip
+    during draft/verify decode repairs token-identically (greedy spec
+    output already equals plain greedy, so the plain baseline is the
+    reference)."""
+    model, params = tiny_model_params
+    e = _engine(model, params, nonfinite_policy="repair")
+    e.attach_draft(model, params)                     # self-draft
+    inj = FaultInjector([{"kind": "poison_row", "frame": 2, "uid": 1}])
+    outs = dict(e.serve(_arrivals(), max_new_tokens=8, faults=inj))
+    for u, base in fault_free_base.items():
+        assert np.array_equal(outs[u], base), f"uid={u}"
+    assert e.telemetry.counters["quarantined"] == 0
+    _assert_clean(e)
+
+
+def test_nonfinite_repair_inframe_transfer_guard(repair_engine,
+                                                 fault_free_base,
+                                                 frame_transfer_guard):
+    """Repair adds only frame-BOUNDARY device traffic (latch read, batched
+    clear, watermark resync): the in-frame transfer guard stays green."""
+    e = repair_engine
+    inj = FaultInjector([{"kind": "poison_row", "frame": 1, "uid": 1}])
+    outs = dict(e.serve(_arrivals(), max_new_tokens=8, faults=inj))
+    assert np.array_equal(outs[1], fault_free_base[1])
+    _assert_clean(e)

@@ -199,9 +199,10 @@ def test_spec_counter_parity_with_host_replay(tiny_model_params, monkeypatch):
     host = {"fwds": 0, "emitted": 0}
     orig = DeviceSlotTable.run_frame
 
-    def spy(self, runner, eng_params, kv, width, steps, greedy, draft=None):
+    def spy(self, runner, eng_params, kv, width, steps, greedy, draft=None,
+            **kw):
         toks, emit = orig(self, runner, eng_params, kv, width, steps, greedy,
-                          draft=draft)
+                          draft=draft, **kw)
         if emit.ndim == 3 and width == 1:
             host["fwds"] += int(emit[:, :, 0].sum())
             host["emitted"] += int(emit.sum())
