@@ -15,9 +15,17 @@ assertions, tp parity suites. This package checks the same invariants
   Python branching on tracer values (GL101), unhashable static arguments
   (GL102), dtype-promotion drift (GL103), host coercions in jitted code
   (GL104), ``print`` in jitted code (GL105).
+- **Family C (graft-cost)** — interpret the same traced programs into a
+  quantitative per-program cost ledger (matmul FLOPs, HBM bytes, per-axis
+  collective wire bytes, boundary D2H bytes) and gate it: regression vs
+  the committed ``.graft-cost-baseline.json`` (GL201), the quantized/ring
+  collective payload contracts (GL202), the O(batch) frame-boundary
+  transfer budget (GL203), and redundant-collective detection (GL204).
 
 CLI: ``python -m deepspeed_tpu.analysis.lint deepspeed_tpu/`` (or
-``bin/dstpu_lint``). See README "Static analysis".
+``bin/dstpu_lint``; ``--cost-report`` for the per-program table,
+``--update-cost-baseline`` to re-record the ledger). See README "Static
+analysis".
 """
 
 from .findings import (Finding, RULES, load_baseline, write_baseline,

@@ -61,6 +61,35 @@ RULES: Dict[str, tuple] = {
               "of identical (bucket-compatible) shapes — a retrace per "
               "call in production",
               "compile_count_total() budgets in the serving tests"),
+    # ---- Family C: jaxpr cost model (graft-cost; cost_model.py) ----
+    "GL201": ("cost-regression", ERROR,
+              "a per-program cost metric (matmul FLOPs, HBM bytes, "
+              "collective payload bytes, boundary D2H bytes) drifted "
+              "beyond tolerance vs the committed .graft-cost-baseline.json "
+              "— unexplained growth fails; explain it and re-record with "
+              "--update-cost-baseline",
+              "serving_bench.py trend rows (SERVING_r*.json)"),
+    "GL202": ("collective-lowering-contract", ERROR,
+              "a non-default collective lowering breaks its payload "
+              "contract: the tp_quantized_collectives program's int8 wire "
+              "bytes exceed 0.5x the exact program's total (+ scales), or "
+              "a tp_overlap_collectives ring program's total wire bytes "
+              "differ from the exact psum's (2(N-1) chunks x chunk size)",
+              "tests/test_serving_tp.py parity-at-tolerance contracts"),
+    "GL203": ("boundary-transfer-budget", ERROR,
+              "a frame program's host-read outputs exceed the boundary "
+              "D2H budget: anything beyond the (steps, B) emission stream "
+              "plus O(batch) per-row lanes scales a per-frame transfer "
+              "with sequence length / vocab / pool size",
+              "frame_transfer_guard fixture (existence complement: zero "
+              "IN-frame D2H; this rule bounds the boundary's SIZE)"),
+    "GL204": ("redundant-collective", ERROR,
+              "the same operand reduced twice over the same mesh axis, a "
+              "collective applied to an already-reduced (replica-"
+              "invariant) value, or an all-gather whose result is "
+              "immediately summed away — N x the wire bytes for a value "
+              "one collective computes",
+              "none (pure waste: numerically invisible)"),
     # ---- Family B: AST lint for retrace hazards ----
     "GL101": ("tracer-branch", ERROR,
               "Python `if`/`while`/`assert` on a traced value inside a "

@@ -34,6 +34,8 @@ and walks the resulting ``ClosedJaxpr``:
 """
 
 import dataclasses
+import os
+import traceback
 from typing import Callable, List, Optional, Sequence, Set
 
 from .findings import Finding
@@ -68,12 +70,20 @@ class TracedProgram:
     crashes — an implicit host coercion raises TracerArrayConversionError
     (GL001) and an unbound collective axis raises NameError (GL003).
     ``retrace`` must rebuild the jit from scratch so the comparison cannot
-    be satisfied by a cache hit."""
+    be satisfied by a cache hit.
+
+    ``variant``/``counterpart`` exist for the Family C cost pass
+    (``cost_model``): a program traced with a non-default collective
+    lowering ("quantized"/"overlap") names the exact-collectives program
+    it must be payload-compared against. The default registry is all
+    ``variant="exact"``."""
     name: str
     trace: Callable[[], object]          # () -> object with .jaxpr
     donate_argnums: Sequence[int] = ()   # FLAT indices (match .in_avals)
     donate_user_args: Sequence[int] = ()  # user positional args (pytrees=1)
     retrace: Optional[Callable[[], object]] = None
+    variant: str = "exact"               # "exact" | "quantized" | "overlap"
+    counterpart: str = ""                # exact twin's name (cost variants)
 
     _traced: object = dataclasses.field(default=None, repr=False)
     _trace_error: Optional[BaseException] = dataclasses.field(
@@ -125,6 +135,31 @@ def _trace_failure(prog: TracedProgram) -> Optional[BaseException]:
         return None
     except Exception as e:               # noqa: BLE001
         return e
+
+
+def failure_frame(err: BaseException) -> str:
+    """``file.py:NN in fn`` for the most useful traceback frame of a trace
+    failure: the INNERMOST frame inside this repo (the serving/analysis
+    code that actually drifted), falling back to the innermost frame
+    overall when the whole stack is framework-internal. A GL000 finding
+    without this is near-undebuggable from the JSON output — the program
+    name says *what* failed to trace, never *where*."""
+    frames = traceback.extract_tb(err.__traceback__) if err.__traceback__ \
+        else []
+    if not frames:
+        return "<no traceback>"
+    here = os.path.abspath(__file__)           # this checker module only:
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+    pick = next(
+        (f for f in reversed(frames)
+         # the TracedProgram re-raise in THIS file is plumbing, never the
+         # cause — but analysis/programs.py (registry shape drift) very
+         # much can be, so only this module is excluded
+         if os.path.abspath(f.filename).startswith(repo)
+         and os.path.abspath(f.filename) != here),
+        frames[-1])
+    where = os.path.basename(pick.filename)
+    return f"{where}:{pick.lineno} in {pick.name}"
 
 
 # ---------------------------------------------------------------------------
@@ -417,18 +452,33 @@ ALL_JAXPR_CHECKS = (check_transfer, check_donation, check_collectives,
                     check_retrace)
 
 
+def check_variant_program(prog: TracedProgram) -> List[Finding]:
+    """GL001/GL002 (+ loud GL000) for the cost registry's non-default
+    collective lowerings: GL003's taint pass cannot prove the ppermute
+    ring replica-invariant (ring algebra, not local dataflow) and GL004
+    is already pinned by the exact twin of the same entry point, so the
+    variant twins run the transfer/donation checks only."""
+    out = check_transfer(prog) + check_donation(prog)
+    return _with_gl000(prog, out)
+
+
 def check_program(prog: TracedProgram) -> List[Finding]:
     out: List[Finding] = []
     for check in ALL_JAXPR_CHECKS:
         out.extend(check(prog))
+    return _with_gl000(prog, out)
+
+
+def _with_gl000(prog: TracedProgram, out: List[Finding]) -> List[Finding]:
     err = _trace_failure(prog)
     if err is not None and not out:
         # the trace died for a reason no rule classifies (signature drift,
         # bad registry shapes, ...): a silent [] here would report "clean"
-        # for a program that was never analyzed — fail loud instead
+        # for a program that was never analyzed — fail loud; the innermost
+        # repo traceback frame makes the abort debuggable from JSON output
         out.append(Finding(
             "GL000", JAXPR_PATH, 0,
-            f"tracing failed with {type(err).__name__}: {err} — the jaxpr "
-            "checks (GL001-GL004) did not run for this program",
+            f"tracing failed at {failure_frame(err)} with {err!r} — the "
+            "jaxpr checks (GL001-GL004) did not run for this program",
             context=prog.name))
     return out

@@ -5,12 +5,18 @@
     python -m deepspeed_tpu.analysis.lint deepspeed_tpu/ \
         --baseline .graft-lint-baseline.json
     bin/dstpu_lint --format json deepspeed_tpu/inference/
+    bin/dstpu_lint --cost-report            # per-program cost table
+    bin/dstpu_lint --update-cost-baseline   # re-record .graft-cost-baseline
 
-Runs Family B (AST) over the given paths and Family A (jaxpr, the traced
-serving programs) unless ``--ast-only``; applies inline suppressions, then
-the baseline; exits 0 when no NEW findings remain, 1 otherwise, 2 on an
-internal error. ``--write-baseline`` records the current findings as
-accepted (repo policy: keep it empty — fix or inline-suppress instead).
+Runs Family B (AST) over the given paths and, unless ``--ast-only``,
+Family A (jaxpr invariants over the traced serving programs) plus
+Family C (graft-cost: the static cost model, rules GL201-GL204, gated
+against the committed ``.graft-cost-baseline.json``); applies inline
+suppressions, then the baseline; exits 0 when no NEW findings remain, 1
+otherwise, 2 on an internal error. ``--write-baseline`` records the
+current findings as accepted (repo policy: keep it empty — fix or
+inline-suppress instead). ``--update-cost-baseline`` re-records the cost
+baseline — the resulting diff belongs in the PR description.
 
 The jaxpr family needs a CPU backend with >= 8 devices to trace the
 tensor-parallel programs; the CLI forces the same virtual mesh the test
@@ -94,17 +100,37 @@ def run_ast_family(paths: List[str]) -> (List[Finding], Dict[str, str]):
     return findings, sources
 
 
-def run_jaxpr_family(include_tp=None) -> List[Finding]:
-    """Trace the serving registry and run all four jaxpr checks. Imports
-    jax lazily — callers must have set the platform env first."""
+def run_jaxpr_family(include_tp=None, programs=None) -> List[Finding]:
+    """Trace the serving registry and run the jaxpr checks: the full
+    GL001-GL004 set on exact-collectives programs, GL001/GL002 on the cost
+    registry's quantized/ring variant twins (see
+    ``jaxpr_checks.check_variant_program``). Imports jax lazily — callers
+    must have set the platform env first."""
     import logging
     logging.getLogger("DeepSpeedTPU").setLevel(logging.ERROR)
-    from .jaxpr_checks import check_program
-    from .programs import build_serving_programs
+    from .jaxpr_checks import check_program, check_variant_program
+    if programs is None:
+        from .programs import build_serving_programs
+        programs = build_serving_programs(include_tp=include_tp)
     findings: List[Finding] = []
-    for prog in build_serving_programs(include_tp=include_tp):
-        findings.extend(check_program(prog))
+    for prog in programs:
+        if prog.variant == "exact":
+            findings.extend(check_program(prog))
+        else:
+            findings.extend(check_variant_program(prog))
     return findings
+
+
+def run_cost_family(programs, baseline_path=None, include_tp=True):
+    """Family C over an already-traced registry: measure every program and
+    run GL201 (when a baseline is available) + GL202/GL203/GL204. Returns
+    (findings, reports)."""
+    from .cost_model import load_cost_baseline, run_cost_checks
+    baseline = None
+    if baseline_path is not None:
+        baseline = load_cost_baseline(baseline_path)
+    return run_cost_checks(programs, baseline=baseline,
+                           include_tp=include_tp)
 
 
 def _force_cpu_mesh() -> None:
@@ -134,11 +160,25 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="record current findings into --baseline and exit 0")
     ap.add_argument("--ast-only", action="store_true",
-                    help="skip the jaxpr family (no tracing/engine builds; "
-                         "via bin/dstpu_lint this also skips the framework "
-                         "import entirely)")
+                    help="skip the jaxpr AND cost families (no tracing/"
+                         "engine builds; via bin/dstpu_lint this also skips "
+                         "the framework import entirely)")
     ap.add_argument("--no-tp", action="store_true",
                     help="skip the tensor-parallel (shard_map) programs")
+    ap.add_argument("--no-cost", action="store_true",
+                    help="skip Family C (the graft-cost model, GL201-GL204)")
+    ap.add_argument("--cost-baseline", metavar="FILE",
+                    help="cost-baseline file for GL201 (default: "
+                         ".graft-cost-baseline.json at the repo root of the "
+                         "first scanned path; GL201 is skipped if the "
+                         "default is absent, exit 2 if an explicit one is)")
+    ap.add_argument("--update-cost-baseline", action="store_true",
+                    help="re-record every program's cost metrics into the "
+                         "cost baseline and exit 0 (the diff belongs in the "
+                         "PR description)")
+    ap.add_argument("--cost-report", action="store_true",
+                    help="print the per-program cost table (markdown, or "
+                         "structured with --format json) and exit 0")
     ap.add_argument("--rules", metavar="GL001,GL101,...",
                     help="restrict to these rule ids")
     ap.add_argument("--list-rules", action="store_true")
@@ -157,14 +197,68 @@ def main(argv=None) -> int:
             print(f"graft-lint: no such file or directory: {p}",
                   file=sys.stderr)
             return 2
+    if args.ast_only and (args.cost_report or args.update_cost_baseline):
+        ap.error("--cost-report/--update-cost-baseline trace the serving "
+                 "programs and cannot combine with --ast-only")
+    if args.update_cost_baseline and (args.no_tp or args.no_cost):
+        # a partial registry would overwrite the committed baseline
+        # wholesale, silently dropping every tp/quantized/ring entry
+        ap.error("--update-cost-baseline records the FULL registry and "
+                 "cannot combine with --no-tp/--no-cost")
     findings, sources = run_ast_family(paths)
     if not args.ast_only:
         try:
             _force_cpu_mesh()
-            findings.extend(run_jaxpr_family(
-                include_tp=False if args.no_tp else None))
+            import jax
+            import logging
+            logging.getLogger("DeepSpeedTPU").setLevel(logging.ERROR)
+            include_tp = (False if args.no_tp
+                          else len(jax.devices()) >= 8)
+            run_cost = not args.no_cost
+            if run_cost:
+                from .programs import build_cost_programs
+                programs = build_cost_programs(include_tp=include_tp)
+            else:
+                from .programs import build_serving_programs
+                programs = build_serving_programs(include_tp=include_tp)
+            cost_base = args.cost_baseline or os.path.join(
+                _anchor_for(os.path.abspath(paths[0])),
+                ".graft-cost-baseline.json")
+            if args.update_cost_baseline:
+                from .cost_model import run_cost_checks, write_cost_baseline
+                _, reports = run_cost_checks(programs, baseline=None)
+                write_cost_baseline(cost_base, reports)
+                print(f"graft-lint: recorded cost metrics for "
+                      f"{len(reports)} program(s) to {cost_base}",
+                      file=sys.stderr)
+                return 0
+            if args.cost_report:
+                from .cost_model import render_cost_table, run_cost_checks
+                _, reports = run_cost_checks(programs, baseline=None)
+                if args.format == "json":
+                    print(json.dumps(
+                        {"cost_report": [r.as_json() for r in sorted(
+                            reports, key=lambda r: r.name)]}, indent=2))
+                else:
+                    print(render_cost_table(reports))
+                return 0
+            findings.extend(run_jaxpr_family(programs=programs))
+            if run_cost:
+                if not os.path.exists(cost_base):
+                    if args.cost_baseline:
+                        print(f"graft-lint: cannot read cost baseline "
+                              f"{cost_base}: no such file", file=sys.stderr)
+                        return 2
+                    print(f"graft-lint: no cost baseline at {cost_base} — "
+                          "GL201 skipped (record one with "
+                          "--update-cost-baseline)", file=sys.stderr)
+                    cost_base = None
+                cost_findings, _ = run_cost_family(
+                    programs, baseline_path=cost_base,
+                    include_tp=include_tp)
+                findings.extend(cost_findings)
         except Exception as e:            # noqa: BLE001
-            print(f"graft-lint: jaxpr family failed: "
+            print(f"graft-lint: jaxpr/cost families failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             return 2
 

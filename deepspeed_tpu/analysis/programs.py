@@ -32,7 +32,7 @@ from .jaxpr_checks import TracedProgram
 _GAMMA = 2
 
 
-def _tiny_engine(tp: int = 1):
+def _tiny_engine(tp: int = 1, quantized: bool = False, overlap: bool = False):
     import jax
     from ..models import build_model
     from ..inference.v2.engine_v2 import (InferenceEngineV2,
@@ -41,7 +41,8 @@ def _tiny_engine(tp: int = 1):
     params = model.init(jax.random.PRNGKey(0))
     cfg = RaggedInferenceEngineConfig(
         kv_block_size=16, prefill_chunk_size=8, max_tokens_per_step=64,
-        max_ragged_batch_size=4, frame_steps=2, dtype="float32", tp=tp)
+        max_ragged_batch_size=4, frame_steps=2, dtype="float32", tp=tp,
+        tp_quantized_collectives=quantized, tp_overlap_collectives=overlap)
     eng = InferenceEngineV2(model, cfg, params=params, max_seq_len=64)
     eng.attach_draft(model, params)    # self-draft: spec loops traceable
     return eng
@@ -139,6 +140,17 @@ def _engine_programs(eng, tag: str) -> List[TracedProgram]:
                  lambda: runner._build_frame_loop_spec(draft_runner), spec(),
                  dict(width=1, steps=2, greedy=True, gamma=_GAMMA,
                       repair=True)),
+        # a draft-carrying engine dispatches its WIDE (prefill) frames
+        # through frame_loop_spec too — width=chunk is a distinct compiled
+        # program (the draft ingests the same chunk), so it needs its own
+        # coverage; the registry-completeness test pins this variant matrix
+        _program(f"frame_loop_spec[w=8]{tag}",
+                 lambda: runner._build_frame_loop_spec(draft_runner), spec(),
+                 dict(width=8, steps=2, greedy=True, gamma=_GAMMA)),
+        _program(f"frame_loop_spec[w=8,repair]{tag}",
+                 lambda: runner._build_frame_loop_spec(draft_runner), spec(),
+                 dict(width=8, steps=2, greedy=True, gamma=_GAMMA,
+                      repair=True)),
         _program(f"mixed_loop{tag}", runner._build_mixed_loop,
                  (eng.params, prompts, plens, limits, kv.k, kv.v, tables,
                   rng, temp),
@@ -197,4 +209,54 @@ def build_serving_programs(include_tp: Optional[bool] = None
         include_tp = len(jax.devices()) >= 8
     if include_tp:
         progs += _engine_programs(_tiny_engine(tp=8), "[tp=8]")
+    return progs
+
+
+#: base entry points re-traced under each non-default collective lowering
+#: for the Family C payload contracts (GL202): the frame/mixed loops issue
+#: the per-layer psums + the logit gather, which is everything the
+#: quantized/overlap flags touch. Repair twins are skipped — the repair
+#: selects change no collective, so their payloads are the non-repair ones.
+_COST_VARIANT_BASES = ("frame_loop[w=8]", "frame_loop[w=1]",
+                       "frame_loop_spec[w=1]", "frame_loop_spec[w=8]",
+                       "mixed_loop", "mixed_loop_spec")
+
+
+def _variant_programs(eng, tag: str, variant: str) -> List[TracedProgram]:
+    progs = [p for p in _engine_programs(eng, tag)
+             if p.name.replace(tag, "") in _COST_VARIANT_BASES]
+    for p in progs:
+        p.variant = variant
+        p.counterpart = p.name.replace(tag, "[tp=8]")
+    return progs
+
+
+def build_cost_programs(include_tp: Optional[bool] = None
+                        ) -> List[TracedProgram]:
+    """The Family C (graft-cost) registry: every serving program the
+    GL001-GL004 registry traces — same engines, same shapes, so the two
+    families describe the same compiled artifacts — PLUS tp=8 twins traced
+    under the non-default collective lowerings:
+
+    - ``variant="quantized"`` (``tp_quantized_collectives``): the EQuARX
+      int8 programs GL202 payload-compares against their exact
+      counterparts;
+    - ``variant="overlap"`` (``tp_overlap_collectives``): the T3 ring
+      programs whose total wire bytes must EQUAL the exact psum's
+      (2(N-1) ppermute chunks x chunk bytes = the ring all-reduce cost).
+
+    The variant twins get GL001/GL002 coverage from the cost gate but NOT
+    GL003 (the ring is replica-invariant by ring algebra, which the taint
+    pass cannot prove — same reason the main registry traces exact
+    collectives only) and not GL004 (one trace each; the exact twins
+    already pin retrace determinism of the shared entry points)."""
+    import jax
+    if include_tp is None:
+        include_tp = len(jax.devices()) >= 8
+    progs = build_serving_programs(include_tp=include_tp)
+    if include_tp:
+        progs += _variant_programs(_tiny_engine(tp=8, quantized=True),
+                                   "[tp=8,quant]", "quantized")
+        progs += _variant_programs(_tiny_engine(tp=8, overlap=True),
+                                   "[tp=8,ring]", "overlap")
     return progs

@@ -22,10 +22,19 @@ interchangeable lowerings per collective, picked by ``TPCollectives`` flags:
 - **quantized** (EQuARX, arXiv 2506.17615) — int8 payloads with per-row
   f32 scales for the activation all-reduces and the logit all-gather:
   2-4x less inter-chip traffic per step in exchange for bounded error.
-  Tolerance contract: symmetric per-row quantization bounds the element
-  error by ``amax_row / 127`` per participating shard (the parity test in
-  ``tests/test_serving_tp.py`` asserts final logits within rtol=0.1 of the
-  exact path and that generation still completes).
+  The all-reduce is a quantized reduce-scatter (``all_to_all`` of int8
+  chunks + scales, dequantize-accumulate locally) followed by an int8
+  all-gather of the reduced chunks — wire bytes 2(N-1)/N x 1 byte per
+  element, a true 4x under the exact f32 ring, which graft-cost rule
+  GL202 proves statically per traced program (the earlier gather-based
+  lowering moved (N-1) x 1 byte per element: int8 on the wire but ZERO
+  saving over an exact ring all-reduce at N=8 — exactly the kind of
+  claim-vs-program gap the cost model exists to catch). Tolerance
+  contract: symmetric per-chunk-row quantization bounds the element
+  error by ``amax_row / 127`` per participating shard plus one
+  requantization of the reduced chunk (the parity test in
+  ``tests/test_serving_tp.py`` asserts final logits within rtol=0.1 of
+  the exact path and that generation still completes).
 
 All functions must be called inside a ``shard_map`` manual region where
 ``axis`` is a manual mesh axis; ``degree == 1`` short-circuits to identity.
@@ -107,15 +116,44 @@ def _quantize_int8(x):
 
 
 def psum_quantized(x, axis: str, degree: int):
-    """All-reduce with int8 payloads: quantize the local partial sum,
-    exchange int8 + scales, dequantize-accumulate in the compute dtype.
-    Traffic: 1 byte/element + one f32 scale per row per shard."""
+    """All-reduce with int8 payloads, reduce-scatter shaped so the wire
+    bytes actually shrink: chunk the last dim ``degree`` ways, quantize
+    each chunk with its own per-row scale, ``all_to_all`` the int8 chunks
+    (shard r receives every shard's chunk r — (N-1)/N x 1 byte/element),
+    dequantize-accumulate locally in f32, then requantize the reduced
+    chunk once and all-gather it back around ((N-1)/N x 1 byte/element
+    again). Total int8 wire: 2(N-1)/N bytes per element — the same ring
+    schedule as an exact all-reduce at a quarter the width, which is the
+    EQuARX claim graft-cost GL202 checks against the exact program.
+
+    Error: each contribution is quantized once (finer per-chunk scales
+    than whole-row) plus one requantization of the reduced chunk.
+
+    Falls back to a gather-based int8 exchange when the last dim doesn't
+    chunk evenly (tiny tensors aren't worth scattering)."""
     if degree == 1:
         return x
-    q, s = _quantize_int8(x)
-    qg = jax.lax.all_gather(q, axis)                   # (degree, ...)
-    sg = jax.lax.all_gather(s, axis)
-    return jnp.sum(qg.astype(jnp.float32) * sg, axis=0).astype(x.dtype)
+    d = x.shape[-1]
+    if d % degree != 0:
+        q, s = _quantize_int8(x)
+        qg = jax.lax.all_gather(q, axis)               # (degree, ...)
+        sg = jax.lax.all_gather(s, axis)
+        return jnp.sum(qg.astype(jnp.float32) * sg, axis=0).astype(x.dtype)
+    shard = d // degree
+    chunks = x.reshape(x.shape[:-1] + (degree, shard))
+    q, s = _quantize_int8(chunks)                      # s: (..., degree, 1)
+    ca = x.ndim - 1                                    # the chunk axis
+    qx = jax.lax.all_to_all(q, axis, split_axis=ca, concat_axis=ca,
+                            tiled=True)
+    sx = jax.lax.all_to_all(s, axis, split_axis=ca, concat_axis=ca,
+                            tiled=True)
+    red = jnp.sum(qx.astype(jnp.float32) * sx, axis=-2)   # (..., shard)
+    q2, s2 = _quantize_int8(red)
+    qg = jax.lax.all_gather(q2, axis, axis=x.ndim - 1, tiled=True)
+    sg = jax.lax.all_gather(s2, axis, axis=x.ndim - 1, tiled=True)
+    deq = (qg.reshape(qg.shape[:-1] + (degree, shard)).astype(jnp.float32)
+           * sg[..., None])
+    return deq.reshape(x.shape[:-1] + (d,)).astype(x.dtype)
 
 
 def all_gather_quantized(x, axis: str, degree: int):

@@ -133,6 +133,48 @@ def _retrace_budget(request):
                         f"{r.compile_count()}" for r, n in over))
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Sanitizer mode: print the graft-cost delta vs the committed
+    baseline at session teardown, next to the per-suite retrace budgets —
+    the dynamic session ends with the static ledger's verdict on the
+    programs it just exercised. Only runs when a serving/analysis suite
+    was collected (the tracing costs ~15s; a config-only run shouldn't
+    pay it)."""
+    if not SANITIZE:
+        return
+    suites = SERVING_SUITES + ("test_static_analysis", "test_cost_model")
+    items = getattr(session, "items", []) or []
+    if not any(it.nodeid.rsplit("/", 1)[-1].split(".py")[0] in suites
+               for it in items):
+        return
+    try:
+        import logging
+        logging.getLogger("DeepSpeedTPU").setLevel(logging.ERROR)
+        from deepspeed_tpu.analysis.cost_model import (load_cost_baseline,
+                                                       run_cost_checks)
+        from deepspeed_tpu.analysis.programs import build_cost_programs
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        baseline = load_cost_baseline(
+            os.path.join(root, ".graft-cost-baseline.json"))
+        findings, reports = run_cost_checks(build_cost_programs(),
+                                            baseline=baseline)
+        drift = [f for f in findings if f.rule == "GL201"]
+        if drift:
+            print(f"\n[graft-sanitize] cost-report delta: {len(drift)} "
+                  "metric(s) off baseline:")
+            for f in drift:
+                print(f"[graft-sanitize]   {f.render()}")
+        else:
+            print(f"\n[graft-sanitize] cost report matches baseline "
+                  f"({len(reports)} programs; retrace budgets above)")
+        other = [f for f in findings if f.rule != "GL201"]
+        for f in other:
+            print(f"[graft-sanitize]   {f.render()}")
+    except Exception as e:   # noqa: BLE001 — teardown must never mask results
+        print(f"\n[graft-sanitize] cost-report delta unavailable: "
+              f"{type(e).__name__}: {e}")
+
+
 def pytest_configure(config):
     # tier-1 runs `-m 'not slow'`: anything wall-clock-sensitive (telemetry
     # latency-value assertions, benchmarks) carries this marker so the

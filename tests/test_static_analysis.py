@@ -169,6 +169,28 @@ def test_unclassified_trace_failure_is_loud_not_vacuous():
     assert "TypeError" in got[0].message
 
 
+def test_gl000_carries_the_innermost_traceback_frame():
+    """A GL000 finding names the file:line (and function) the trace abort
+    was raised from plus the exception repr — without it, a trace abort is
+    near-undebuggable from the JSON output (the program name says WHAT
+    failed, never WHERE)."""
+    from deepspeed_tpu.analysis.jaxpr_checks import (TracedProgram,
+                                                     check_program)
+
+    def _deep_helper():
+        raise ValueError("registry shape drifted")
+
+    def broken():
+        return _deep_helper()
+
+    got = check_program(TracedProgram(name="fixture:located", trace=broken,
+                                      retrace=broken))
+    assert [f.rule for f in got] == ["GL000"]
+    msg = got[0].message
+    assert "test_static_analysis.py:" in msg and "in _deep_helper" in msg
+    assert "ValueError('registry shape drifted')" in msg
+
+
 # ---------------------------------------------------------------------------
 # Family B golden: the # expect: markers in bad_ast.py are the spec
 # ---------------------------------------------------------------------------
@@ -299,6 +321,35 @@ def test_dispatch_donation_table_matches_live_traces(serving_programs):
             f"{expect} — a loop grew/lost a carry; update ast_checks")
     assert seen == set(DISPATCH_DONATIONS), (
         f"programs registry no longer traces {set(DISPATCH_DONATIONS) - seen}")
+
+
+def test_registry_completeness_against_dispatch_sites(serving_programs):
+    """Every dispatch site in DISPATCH_DONATIONS is traced in its FULL
+    production variant matrix: both tp degrees for the shard_map loops,
+    both widths for the frame loops (a draft engine dispatches its WIDE
+    prefill frames through frame_loop_spec too), and the
+    nonfinite_policy="repair" twins of every frame program. A new serving
+    loop that registers its donation contract but not its trace cannot
+    slip past Family A (GL001-GL004) — and Family C shares this registry,
+    so it cannot skip the cost ledger either."""
+    names = {p.name for p in serving_programs}
+    expected = set()
+    for tp in ("", "[tp=8]"):
+        for w in ("w=1", "w=8"):
+            expected |= {f"frame_loop[{w}]{tp}",
+                         f"frame_loop[{w},repair]{tp}",
+                         f"frame_loop_spec[{w}]{tp}",
+                         f"frame_loop_spec[{w},repair]{tp}"}
+        expected |= {f"mixed_loop{tp}", f"mixed_loop_spec{tp}"}
+    # host-step + page-mover programs never compile under shard_map
+    expected |= {"decode_loop", "run[chunk=8]", "copy_blocks",
+                 "scatter_pages", "gather_pages"}
+    missing = expected - names
+    assert not missing, f"registry is missing production variants: " \
+                        f"{sorted(missing)}"
+    # ...and the matrix covers every donation-contract dispatch site
+    bases = {n.split("[")[0] for n in expected}
+    assert set(DISPATCH_DONATIONS) <= bases
 
 
 def test_repo_lint_clean(serving_programs):
