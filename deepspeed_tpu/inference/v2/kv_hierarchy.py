@@ -74,6 +74,7 @@ class PrefixEntry:
     block: Optional[int]        # device block id; None = swapped out
     source_uid: int             # publisher (quarantine invalidation)
     last_used: int = 0          # LRU clock stamp
+    hits: int = 0               # admission matches served (victim scoring)
 
 
 class PrefixCache:
@@ -84,11 +85,16 @@ class PrefixCache:
     into a spill to host RAM instead of a drop. The cache never owns the
     pools — it holds allocator references and block ids only."""
 
-    def __init__(self, kv, max_blocks: Optional[int] = None, swap=None):
+    def __init__(self, kv, max_blocks: Optional[int] = None, swap=None,
+                 tag: str = ""):
         self.kv = kv
         self.bs = kv.block_size
         self.max_blocks = max_blocks
         self.swap = swap
+        # spill-record namespace: several engines' prefix caches may share
+        # ONE tier (the disaggregated fleet), and entry ids are per-cache —
+        # the tag keeps their ``kvblk_`` keys from colliding
+        self.tag = tag
         # set by the engine when a speculative draft is attached: spilled
         # prefix pages then carry the draft pool's page too, so a restored
         # block keeps draft acceptance instead of proposing against stale
@@ -119,7 +125,7 @@ class PrefixCache:
         return self._clock
 
     def _bkey(self, e: PrefixEntry) -> str:
-        return f"kvblk_{e.eid}"
+        return f"kvblk_{self.tag}{e.eid}"
 
     # ------------------------------------------------------------------
     # publish: full blocks below the committed watermark enter the index
@@ -261,10 +267,12 @@ class PrefixCache:
         return True
 
     def touch(self, entries: Sequence[PrefixEntry], hit_tokens: int) -> None:
-        """Stamp a successful hit (LRU + counters)."""
+        """Stamp a successful hit (LRU + per-entry hit frequency +
+        counters)."""
         now = self._tick()
         for e in entries:
             e.last_used = now
+            e.hits += 1
         if hit_tokens > 0:
             self.stats["hits"] += 1
             self.stats["hit_tokens"] += hit_tokens
@@ -300,23 +308,57 @@ class PrefixCache:
             self._children.get(e.parent, set()).discard(e.eid)
         return n
 
+    def _subtree_sizes(self) -> Dict[int, int]:
+        """Resident device blocks per entry's subtree (what a no-tier
+        eviction of that entry would actually unpin), for EVERY entry in
+        ONE iterative post-order pass over the forest — per-candidate
+        subtree walks would make a pressure reclaim quadratic in resident
+        entries on the common chain-shaped caches."""
+        sizes: Dict[int, int] = {}
+        roots = [e for e in self._by_id.values()
+                 if e.parent not in self._by_id]
+        stack = [(e, False) for e in roots]
+        while stack:
+            e, ready = stack.pop()
+            kids = self._children.get(e.eid, ())
+            if ready:
+                sizes[e.eid] = (1 if e.block is not None else 0) + \
+                    sum(sizes[c] for c in kids)
+            else:
+                stack.append((e, True))
+                stack.extend((self._by_id[c], False) for c in kids)
+        return sizes
+
+    def _victim_order(self, cands: List[PrefixEntry]) -> List[PrefixEntry]:
+        """Hit-frequency- and size-aware victim scoring: evict the
+        least-hit entries first (a hot small prefix outlives a cold large
+        one regardless of recency), break hit ties by LARGER subtree first
+        (reclaiming more per eviction), and keep LRU as the final
+        tie-break. Pure ordering — the caller applies the refcount /
+        protect filters."""
+        sizes = self._subtree_sizes() if cands else {}
+        return sorted(cands, key=lambda e: (e.hits,
+                                            -sizes.get(e.eid, 0),
+                                            e.last_used))
+
     def reclaim(self, n_blocks: int, protect: Optional[Set[int]] = None
                 ) -> int:
         """Free up to ``n_blocks`` device blocks from cold UNREFERENCED
-        entries (allocator refcount 1 — the cache's own reference), LRU
-        first. With a swap tier the pages spill to host RAM as ONE batch
-        (one device gather over the whole cold set, queued async writes
-        committed by a single wait, one index rewrite — a pressure event
-        evicting N blocks used to pay that I/O sequence N times) and the
-        entries stay matchable (restored on the next hit); without one the
-        entry (and its now-unreachable subtree) is dropped. Returns the
-        number of device blocks actually freed."""
+        entries (allocator refcount 1 — the cache's own reference), in
+        ``_victim_order`` (hit frequency, then subtree size, LRU as the
+        tie-break). With a swap tier the pages spill to host RAM as ONE
+        batch (one device gather over the whole cold set, queued async
+        writes committed by a single wait, one index rewrite — a pressure
+        event evicting N blocks used to pay that I/O sequence N times) and
+        the entries stay matchable (restored on the next hit); without one
+        the entry (and its now-unreachable subtree) is dropped. Returns
+        the number of device blocks actually freed."""
         protect = protect or set()
         freed = 0
-        cands = sorted((e for e in self._by_id.values()
-                        if e.block is not None and e.eid not in protect
-                        and self.kv.allocator.refcount(e.block) == 1),
-                       key=lambda e: e.last_used)
+        cands = self._victim_order(
+            [e for e in self._by_id.values()
+             if e.block is not None and e.eid not in protect
+             and self.kv.allocator.refcount(e.block) == 1])
         if self.swap is None:
             for e in cands:
                 if freed >= n_blocks:
@@ -372,23 +414,54 @@ class PrefixCache:
 
 class KVSwapTier:
     """Host-RAM tier for committed KV pages, on the ``swap_tensor``
-    machinery. Two record kinds share one ``AsyncTensorSwapper``
+    machinery. Three record kinds share one ``AsyncTensorSwapper``
     (atomic, crash-safe `.swp` commits) plus a tiny JSON index persisted
-    beside the pages, so a tier directory outlives the engine process —
-    ``serve(resume_from=)`` on a fresh engine restores a preempted
-    victim's pages instead of re-prefilling them:
+    beside the pages (``kv_tier_index.json``), so a tier directory
+    outlives the engine process — ``serve(resume_from=)`` on a fresh
+    engine restores a preempted victim's pages instead of re-prefilling
+    them:
 
-    * **request records** (``kvreq_<uid>_*``) — a preempted/crashed
-      request's committed pages (target k/v and, under speculation, the
-      draft pools' pages for the same block ids);
-    * **block records** (``kvblk_<eid>_*``) — single cold prefix-cache
-      pages spilled under KV pressure.
+    * **request records** (``kvreq_<uid>_s<k>_*``) — a preempted, crashed
+      or HANDED-OFF request's committed pages (target k/v and, under
+      speculation, the draft pools' pages for the same block ids). A
+      record is a LIST OF SEGMENTS: a prefill replica publishes each
+      boundary's newly-committed full blocks incrementally
+      (``publish_request_segment``), so a replica killed mid-prompt
+      leaves a restorable partial-watermark record behind and the
+      handoff completion only ever writes the new tail. A record may
+      carry a ``handoff`` metadata dict (the disaggregated fleet's
+      prefill → decode handoff record).
+    * **block records** (``kvblk_<tag><eid>_*``) — single cold
+      prefix-cache pages spilled under KV pressure (per-engine, keyed by
+      in-memory entry ids).
+    * **prefix records** (``kvpfx_<fingerprint>_*``) — CONTENT-ADDRESSED
+      pages covering a chunk-aligned prompt prefix, keyed by the token
+      fingerprint: any engine sharing the tier can match a new prompt
+      against them and admit at the watermark, so a hot shared prompt is
+      prefilled once FLEET-WIDE (``put_prefix`` / ``match_prefix`` /
+      ``restore_prefix``).
+
+    ``shared=True`` marks a tier owned by a FLEET rather than one engine:
+    ``prune_requests`` becomes a no-op (the router owns record lifecycle —
+    one engine's serve() must not drop its peers' handoff records) and
+    per-engine prefix caches attached to it must use distinct ``tag``s.
+
+    Record writes may be queued (``async_commit=True``): the page files
+    ride the aio queue and the index entry lands only at the next
+    ``drain()`` — the engine drains at the following frame boundary, so
+    boundary swap-outs overlap with the next frame instead of committing
+    synchronously. Every read path drains first (blocking), so a queued
+    record is never invisible to a lookup. ``stats`` counts overlapped vs
+    blocking commits.
     """
 
-    def __init__(self, swap_dir: str, aio_handle=None):
+    def __init__(self, swap_dir: str, aio_handle=None, shared: bool = False,
+                 prefix_max_records: Optional[int] = 256):
         self.swapper = AsyncTensorSwapper(swap_dir, aio_handle)
+        self.shared = shared
+        self.prefix_max_records = prefix_max_records
         self._index_path = os.path.join(swap_dir, "kv_tier_index.json")
-        self._index = {"requests": {}, "blocks": {}}
+        self._index = {"requests": {}, "blocks": {}, "prefixes": {}}
         if os.path.exists(self._index_path):
             try:
                 with open(self._index_path) as f:
@@ -396,16 +469,84 @@ class KVSwapTier:
             except (OSError, ValueError):
                 logger.warning(f"KVSwapTier: unreadable index at "
                                f"{self._index_path}; starting empty")
+        self._index.setdefault("prefixes", {})
         self.stats = dict(requests_out=0, requests_in=0, blocks_out=0,
-                          blocks_in=0)
+                          blocks_in=0, commits_overlapped=0,
+                          commits_blocking=0, commit_failures=0,
+                          prefix_records=0, prefix_hits=0)
+        # async-committed records not yet in the index: (section, key, rec)
+        self._pending: List[Tuple[str, str, Dict]] = []
+        self._prefix_clock = max(
+            (r.get("stamp", 0) for r in self._index["prefixes"].values()),
+            default=0)
         # spilled prefix-BLOCK records reference in-memory entry ids, so
         # anything left by a previous process is unreachable by
         # construction — drop it now or a tmpfs tier leaks host RAM on
         # every crash/restart cycle. (Request records stay: they are the
-        # crash-recovery payload; serve() prunes the non-resumed ones.)
-        # One tier directory belongs to one engine at a time.
+        # crash-recovery payload; serve() prunes the non-resumed ones.
+        # Prefix records stay too: they are content-addressed, so a
+        # restarted fleet keeps its fleet-wide prefix share.)
+        # One tier directory belongs to one engine (or one fleet) at a
+        # time.
         for key in list(self._index["blocks"]):
             self.drop_block(key)
+
+    # ---------------- async commit queue (overlapped swap-out) ----------
+
+    def pending_commits(self) -> int:
+        return len(self._pending)
+
+    def drain(self, blocking: bool = True) -> int:
+        """Commit every queued async record write: ONE ``swapper.wait``
+        finalizes the page files, then the records enter the index with a
+        single rewrite. ``blocking=False`` marks a frame-boundary drain
+        (the writes overlapped with the previous frame); ``blocking=True``
+        marks a forced drain (a lookup/restore needed the records NOW, or
+        a synchronous put). On an aio error the swapper rolled every
+        in-flight write back — the queued records are discarded (callers
+        fall back to re-prefill) and the error re-raised."""
+        if not self._pending:
+            return 0
+        pend, self._pending = self._pending, []
+        try:
+            self.swapper.wait()
+        except Exception:
+            self.stats["commit_failures"] += len(pend)
+            raise
+        for section, key, rec in pend:
+            self._index[section][key] = rec
+        self._save_index()
+        self.stats["commits_blocking" if blocking
+                   else "commits_overlapped"] += len(pend)
+        return len(pend)
+
+    def _drain_for_read(self) -> None:
+        """Read paths must see queued records; a failed drain degrades to
+        a miss (the records were rolled back anyway) instead of failing
+        the lookup."""
+        if not self._pending:
+            return
+        try:
+            self.drain(blocking=True)
+        except Exception as e:       # noqa: BLE001 — degrade to a miss
+            logger.warning(f"KVSwapTier: async commit failed at lookup "
+                           f"({type(e).__name__}: {e}); queued records "
+                           "dropped")
+
+    def _stage(self, section: str, key: str, rec: Dict,
+               async_commit: bool) -> None:
+        self._pending = [(s, k, r) for (s, k, r) in self._pending
+                         if not (s == section and k == key)]
+        self._pending.append((section, key, rec))
+        if not async_commit:
+            self.drain(blocking=True)
+
+    def _record(self, section: str, key: str) -> Optional[Dict]:
+        """Committed-or-pending view of one record."""
+        for s, k, r in reversed(self._pending):
+            if s == section and k == key:
+                return r
+        return self._index[section].get(key)
 
     def _save_index(self) -> None:
         tmp = self._index_path + ".tmp"
@@ -443,12 +584,22 @@ class KVSwapTier:
             rec["draft_shape"] = list(self._page_shape(draft_kv, n))
         return rec
 
-    def _put(self, prefix: str, kv, blocks: List[int], draft_kv=None
-             ) -> Dict:
+    def _read(self, kv, blocks: List[int], draft_kv=None):
+        """One device gather + D2H per pool — after this, the payload is
+        host memory and the device blocks may be freed regardless of when
+        the (possibly async) file writes commit."""
         kp, vp = kv.read_pages(blocks)
         dkp = dvp = None
         if draft_kv is not None:
             dkp, dvp = draft_kv.read_pages(blocks)
+        return kp, vp, dkp, dvp
+
+    def _put(self, prefix: str, kv, blocks: List[int], draft_kv=None
+             ) -> Dict:
+        # a foreign pending batch must not share this wait(): an error
+        # would roll BOTH back while the pending records stayed queued
+        self._drain_for_read()
+        kp, vp, dkp, dvp = self._read(kv, blocks, draft_kv)
         rec = self._queue_out(prefix, kv, kp, vp, draft_kv, dkp, dvp)
         self.swapper.wait()      # atomic commit; raises (and rolls back)
         return rec
@@ -489,41 +640,146 @@ class KVSwapTier:
                 draft_kv.k, draft_kv.v, dst_blocks, dkp, dvp)
 
     def _drop(self, prefix: str, rec: Dict) -> None:
+        # commit-or-discard any queued async batch FIRST: release() drains
+        # the shared aio queue internally, so a foreign batch's write
+        # error would otherwise surface out of an ordinary retirement's
+        # drop (crashing serve) while the rolled-back files' records
+        # stayed queued for a later (clean) drain to index dangling.
+        # _drain_for_read keeps both sides consistent — records commit or
+        # are discarded together with their files.
+        self._drain_for_read()
         for suffix in ("_k", "_v") + (("_dk", "_dv") if rec.get("draft")
                                       else ()):
-            self.swapper.release(prefix + suffix)
+            try:
+                self.swapper.release(prefix + suffix)
+            except Exception as e:   # noqa: BLE001 — drop is best-effort
+                logger.warning(f"KVSwapTier: releasing {prefix}{suffix} "
+                               f"failed ({type(e).__name__}: {e})")
 
-    # ---------------- request records (preemption / crash recovery) ----
+    # ---------------- request records (preemption / crash recovery /
+    # prefill→decode handoff) ----
+
+    @staticmethod
+    def _seg_prefix(uid: int, i: int) -> str:
+        return f"kvreq_{uid}_s{i}"
 
     def put_request(self, uid: int, tokens: int, kv, blocks: List[int],
-                    draft_kv=None, fingerprint: Optional[str] = None
-                    ) -> None:
-        """Swap a victim's committed pages out. ``tokens`` is the committed
-        watermark the pages cover and ``fingerprint`` the
-        ``token_fingerprint`` of exactly those tokens — restore validates
-        both, so a stale record (or a reused uid) can never restore pages
-        under different content."""
-        rec = self._put(f"kvreq_{uid}", kv, blocks, draft_kv)
-        rec["tokens"] = int(tokens)
-        rec["fingerprint"] = fingerprint
-        self._index["requests"][str(uid)] = rec
-        self._save_index()
+                    draft_kv=None, fingerprint: Optional[str] = None,
+                    async_commit: bool = False,
+                    handoff: Optional[Dict] = None) -> None:
+        """Swap a victim's committed pages out as a fresh single-segment
+        record. ``tokens`` is the committed watermark the pages cover and
+        ``fingerprint`` the ``token_fingerprint`` of exactly those tokens —
+        restore validates both, so a stale record (or a reused uid) can
+        never restore pages under different content. ``async_commit``
+        queues the page writes on the aio swapper and defers the commit
+        to the next ``drain()`` — the engine drains at the following frame
+        boundary, overlapping the write with the next frame.
+        ``handoff`` attaches the disaggregated-fleet handoff metadata."""
+        if self._record("requests", str(uid)) is not None:
+            self.drop_request(uid)      # uid re-put: release old segments
+        kp, vp, dkp, dvp = self._read(kv, blocks, draft_kv)
+        seg = self._queue_out(self._seg_prefix(uid, 0), kv, kp, vp,
+                              draft_kv, dkp, dvp)
+        rec = {"tokens": int(tokens), "fingerprint": fingerprint,
+               "blocks": len(blocks), "segments": [seg]}
+        if handoff is not None:
+            rec["handoff"] = handoff
+        self._stage("requests", str(uid), rec, async_commit)
         self.stats["requests_out"] += 1
 
+    def publish_request_segment(self, uid: int, tokens: int,
+                                fingerprint: Optional[str], kv,
+                                new_blocks: List[int], draft_kv=None,
+                                async_commit: bool = True,
+                                handoff: Optional[Dict] = None,
+                                start_block: Optional[int] = None) -> bool:
+        """Append one segment of NEWLY-committed pages to ``uid``'s record
+        (creating it at the first call) and advance its watermark to
+        ``tokens`` — the prefill replica's boundary-incremental publish.
+        Content below the watermark is final, so earlier segments are
+        never rewritten; a replica killed mid-prompt leaves the partial
+        watermark restorable from the tier.
+
+        ``start_block`` is the caller's publish cursor (the block index
+        this segment starts at): when it disagrees with the record's
+        actual coverage — a failed drain dropped a queued segment, on
+        THIS engine or a peer sharing the tier — the stale record is
+        dropped and False returned, and the caller must republish from
+        block zero. This enforces the ``blocks == blocks_for(tokens)``
+        restore invariant structurally: a record can never claim a
+        watermark its segments don't contiguously cover."""
+        prev = self._record("requests", str(uid))
+        if prev is not None and "segments" not in prev:
+            # a legacy single-record entry (pre-segment index) cannot be
+            # appended to — replace it outright
+            self.drop_request(uid)
+            prev = None
+        have = prev["blocks"] if prev else 0
+        if start_block is not None and start_block != have:
+            self.drop_request(uid)
+            logger.warning(
+                f"KVSwapTier: uid={uid} publish cursor at block "
+                f"{start_block} but the record covers {have} — a dropped "
+                "commit desynced them; record dropped, republish from "
+                "zero")
+            return False
+        segs = list(prev["segments"]) if prev else []
+        kp, vp, dkp, dvp = self._read(kv, new_blocks, draft_kv)
+        seg = self._queue_out(self._seg_prefix(uid, len(segs)), kv, kp, vp,
+                              draft_kv, dkp, dvp)
+        segs.append(seg)
+        rec = {"tokens": int(tokens), "fingerprint": fingerprint,
+               "blocks": have + len(new_blocks), "segments": segs}
+        if handoff is not None:
+            rec["handoff"] = handoff
+        elif prev and "handoff" in prev:
+            rec["handoff"] = prev["handoff"]
+        self._stage("requests", str(uid), rec, async_commit)
+        self.stats["requests_out"] += 1
+        return True
+
     def request_record(self, uid: int) -> Optional[Dict]:
+        self._drain_for_read()
         return self._index["requests"].get(str(uid))
 
     def restore_request(self, uid: int, kv, dst_blocks: List[int],
                         draft_kv=None) -> None:
+        self._drain_for_read()
         rec = self._index["requests"][str(uid)]
-        self._restore(f"kvreq_{uid}", rec, kv, dst_blocks, draft_kv)
+        segs = rec.get("segments")
+        if segs is None:                # legacy single-record schema
+            self._restore(f"kvreq_{uid}", rec, kv, dst_blocks, draft_kv)
+        else:
+            if len(dst_blocks) != rec["blocks"]:
+                raise IOError(
+                    f"kvreq_{uid}: {rec['blocks']} pages recorded across "
+                    f"{len(segs)} segments, {len(dst_blocks)} destination "
+                    "blocks")
+            off = 0
+            for i, seg in enumerate(segs):
+                n = seg["blocks"]
+                self._restore(self._seg_prefix(uid, i), seg, kv,
+                              dst_blocks[off:off + n], draft_kv)
+                off += n
         self.stats["requests_in"] += 1
 
     def drop_request(self, uid: int) -> None:
-        rec = self._index["requests"].pop(str(uid), None)
+        key = str(uid)
+        pend = [r for (s, k, r) in self._pending
+                if s == "requests" and k == key]
+        self._pending = [(s, k, r) for (s, k, r) in self._pending
+                         if not (s == "requests" and k == key)]
+        rec = self._index["requests"].pop(key, None)
+        rec = pend[-1] if pend else rec
         if rec is None:
             return
-        self._drop(f"kvreq_{uid}", rec)
+        segs = rec.get("segments")
+        if segs is None:
+            self._drop(f"kvreq_{uid}", rec)
+        else:
+            for i, seg in enumerate(segs):
+                self._drop(self._seg_prefix(uid, i), seg)
         self._save_index()
 
     def prune_requests(self, keep_uids) -> int:
@@ -531,12 +787,120 @@ class KVSwapTier:
         start: records exist solely for swap-in re-admission, so a new
         run that will not resume a uid has abandoned its pages — without
         this, every crashed-and-not-resumed request leaks its pages in
-        the tier forever)."""
+        the tier forever). A SHARED tier never prunes: peer replicas'
+        in-flight handoff records look abandoned to any one engine, and
+        the router owns the fleet-level record lifecycle instead."""
+        if self.shared:
+            return 0
         doomed = [u for u in list(self._index["requests"])
                   if int(u) not in keep_uids]
         for u in doomed:
             self.drop_request(int(u))
         return len(doomed)
+
+    # ---------------- prefix records (fleet-wide prefix share) ----------
+
+    def put_prefix(self, tokens: Sequence[int], kv, blocks: List[int],
+                   draft_kv=None, async_commit: bool = True) -> bool:
+        """Publish a CONTENT-ADDRESSED prefix record: pages covering
+        ``tokens`` (a chunk-aligned prompt prefix, exactly
+        ``len(tokens)`` of them), keyed by the token fingerprint so ANY
+        engine sharing the tier can admit a matching prompt at the
+        watermark. First publisher wins (identical content — a second
+        copy would waste tier RAM); beyond ``prefix_max_records`` the
+        stalest committed record is dropped (LRU by hit stamp). Returns
+        whether a record was actually published."""
+        fp = token_fingerprint(tokens)
+        key = f"kvpfx_{fp}"
+        if self._record("prefixes", key) is not None:
+            return False
+        kp, vp, dkp, dvp = self._read(kv, blocks, draft_kv)
+        rec = self._queue_out(key, kv, kp, vp, draft_kv, dkp, dvp)
+        rec["tokens"] = len(tokens)
+        rec["fingerprint"] = fp
+        self._prefix_clock += 1
+        rec["stamp"] = self._prefix_clock
+        if self.prefix_max_records is not None:
+            live = self._index["prefixes"]
+            while len(live) >= self.prefix_max_records:
+                victim = min(live, key=lambda k: live[k].get("stamp", 0))
+                self.drop_prefix(victim)
+        self._stage("prefixes", key, rec, async_commit)
+        self.stats["prefix_records"] += 1
+        return True
+
+    def match_prefix(self, tokens: Sequence[int], chunk: int,
+                     max_probes: int = 64
+                     ) -> Optional[Tuple[str, Dict]]:
+        """Longest published chunk-aligned prefix of ``tokens``: probes
+        fingerprints at descending chunk multiples (a hot identical
+        prompt hits on the first probe), bounded by ``max_probes``.
+        Returns ``(key, record)`` or None; a hit refreshes the record's
+        LRU stamp."""
+        self._drain_for_read()
+        if not self._index["prefixes"]:
+            return None
+        toks = [int(t) for t in tokens]
+        w = (len(toks) // chunk) * chunk
+        probes = 0
+        while w >= chunk and probes < max_probes:
+            key = f"kvpfx_{token_fingerprint(toks[:w])}"
+            rec = self._index["prefixes"].get(key)
+            if rec is not None:
+                self._prefix_clock += 1
+                rec["stamp"] = self._prefix_clock
+                self.stats["prefix_hits"] += 1
+                return key, rec
+            w -= chunk
+            probes += 1
+        return None
+
+    def restore_prefix(self, key: str, kv, dst_blocks: List[int],
+                       draft_kv=None) -> None:
+        """Restore the FIRST ``len(dst_blocks)`` pages of a prefix record
+        into freshly-allocated private blocks. The record is KEPT — it is
+        shared, content-addressed, and reusable by every later admission
+        (unlike request records, which are consumed by their restore)."""
+        self._drain_for_read()
+        rec = self._index["prefixes"][key]
+        n = len(dst_blocks)
+        if not 0 < n <= rec["blocks"]:
+            raise IOError(f"{key}: {n} destination blocks vs "
+                          f"{rec['blocks']} recorded pages")
+        if rec["dtype"] != str(kv.k.dtype):
+            raise IOError(f"{key}: pages were swapped as {rec['dtype']} "
+                          f"but the pool is {kv.k.dtype}")
+        if tuple(rec.get("page_shape", ())) != \
+                self._page_shape(kv, rec["blocks"]):
+            raise IOError(
+                f"{key}: pages were swapped with geometry "
+                f"{rec.get('page_shape')} but the pool expects "
+                f"{self._page_shape(kv, rec['blocks'])}")
+        self._adopt(f"{key}_k", kv, rec["blocks"])
+        self._adopt(f"{key}_v", kv, rec["blocks"])
+        kp = self.swapper.swap_in(f"{key}_k")[:, :, :n]
+        vp = self.swapper.swap_in(f"{key}_v")[:, :, :n]
+        kv.k, kv.v = kv.scatter_pages(kv.k, kv.v, dst_blocks, kp, vp)
+        if rec.get("draft") and draft_kv is not None:
+            if tuple(rec.get("draft_shape", ())) != \
+                    self._page_shape(draft_kv, rec["blocks"]):
+                raise IOError(f"{key}: draft page geometry mismatch")
+            self._adopt(f"{key}_dk", draft_kv, rec["blocks"])
+            self._adopt(f"{key}_dv", draft_kv, rec["blocks"])
+            dkp = self.swapper.swap_in(f"{key}_dk")[:, :, :n]
+            dvp = self.swapper.swap_in(f"{key}_dv")[:, :, :n]
+            draft_kv.k, draft_kv.v = draft_kv.scatter_pages(
+                draft_kv.k, draft_kv.v, dst_blocks, dkp, dvp)
+        self.stats["blocks_in"] += n
+
+    def drop_prefix(self, key: str) -> None:
+        self._pending = [(s, k, r) for (s, k, r) in self._pending
+                         if not (s == "prefixes" and k == key)]
+        rec = self._index["prefixes"].pop(key, None)
+        if rec is None:
+            return
+        self._drop(key, rec)
+        self._save_index()
 
     # ---------------- block records (prefix-cache spill) ----------------
 
@@ -559,6 +923,8 @@ class KVSwapTier:
         assert len(keys) == len(blocks)
         if not keys:
             return
+        # a foreign pending batch must not share this wait() (see _put)
+        self._drain_for_read()
         kp, vp = kv.read_pages(blocks)       # one gather + D2H per pool
         dkp = dvp = None
         if draft_kv is not None:
@@ -579,6 +945,7 @@ class KVSwapTier:
         # pop the record only AFTER a successful restore: a failed read
         # must leave it in place so the caller's drop_block can still
         # release the page files (popping first would leak them)
+        self._drain_for_read()
         rec = self._index["blocks"][str(key)]
         self._restore(key, rec, kv, [dst_block], draft_kv=draft_kv)
         self._index["blocks"].pop(str(key), None)

@@ -41,6 +41,11 @@ class DSSequenceDescriptor:
     # publish walk resumes there instead of re-hashing from token zero)
     published_upto: int = 0
     publish_parent: int = -1        # kv_hierarchy.CHAIN_ROOT
+    # disaggregated serving (engine role="prefill"): FULL blocks already
+    # published to the shared swap tier as request-record segments — the
+    # boundary-incremental publish cursor (kv_hierarchy
+    # ``publish_request_segment``)
+    tier_blocks: int = 0
 
     @property
     def in_prefill(self) -> bool:

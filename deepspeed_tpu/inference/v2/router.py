@@ -50,6 +50,21 @@ Semantics, arXiv 2601.02311 — replicas here are the AVAILABILITY axis,
    finish (``engine.begin_drain`` holds the queue), then the queue is
    snapshot-migrated to the peers.
 
+5. **Disaggregated prefill/decode** (README "Disaggregated prefill/
+   decode"; the DeepSpeed-Inference/FastGen split taken past the paper,
+   since here the handoff is token-identical by construction) — replicas
+   whose engines carry ``role="prefill"`` run wide chunked-prefill frames
+   and, at the committed watermark, publish the request's KV pages into
+   the fleet's SHARED ``KVSwapTier`` and yield a ``HandoffEvent``; the
+   router re-places the request on a decode/unified replica, whose
+   ordinary swap-in admission restores the pages and streams tokens.
+   Arrivals are classified prefill-heavy vs decode-heavy (prompt length
+   vs ``max_new_tokens``); prefill replicas are scored by queued prompt
+   TOKENS, decode replicas by ``placement_score``. The tier also carries
+   content-addressed prefix records, so a hot shared prompt is prefilled
+   once fleet-wide and every later arrival on any replica admits at the
+   watermark.
+
 Everything here is host-side policy over frame boundaries: the router adds
 zero device work and never touches an engine's compiled loops.
 """
@@ -62,7 +77,7 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ...utils.logging import logger
-from .engine_v2 import ServeBoundary
+from .engine_v2 import HandoffEvent, ServeBoundary
 from .faults import FrameDispatchError, snapshot_split
 
 # replica lifecycle states
@@ -115,6 +130,21 @@ class RouterConfig:
     quarantine_backoff_ticks: int = 8
     max_engine_failures: int = 3
     fault_log_max: int = 256
+    # ---- disaggregated prefill/decode placement (engine roles; README
+    # "Disaggregated prefill/decode") ----
+    # an arrival is PREFILL-HEAVY when its prompt is at least this many
+    # times its generation budget (prompt length vs max_new_tokens — the
+    # classification heuristic); prefill-heavy arrivals go to a prefill
+    # replica (scored by queued prompt TOKENS, the signal that predicts
+    # its wide-frame backlog), everything else — including every handoff
+    # and failover resume that already has committed tokens — goes to
+    # decode/unified replicas by placement_score. Inert without prefill
+    # replicas in the fleet.
+    prefill_route_ratio: float = 4.0
+    # absolute floor: prompts shorter than this are never prefill-routed
+    # even when the ratio says so (a 12-token prompt with budget 2 is not
+    # worth a handoff round-trip)
+    prefill_route_min_prompt: int = 32
 
 
 @dataclasses.dataclass
@@ -192,11 +222,55 @@ class EngineRouter:
             raise ValueError("EngineRouter needs at least one engine")
         self._replicas: Dict[str, _Replica] = {
             name: _Replica(name, eng) for name, eng in engines.items()}
+        # replica roles come from the engine configs (engine_v2
+        # ``role=``): "prefill" replicas run chunked prefill and hand off
+        # at the watermark, "decode"/"unified" replicas stream tokens.
+        # The role rides every replica's telemetry as a base label so the
+        # fleet's ds_serving_*/ds_router_* series are separable per role.
+        self._roles: Dict[str, str] = {
+            name: getattr(r.engine._config, "role", "unified")
+            for name, r in self._replicas.items()}
+        self._has_prefill = any(v == "prefill" for v in self._roles.values())
         for name, r in self._replicas.items():
             cfg = r.engine.model.cfg
             label = (model_labels or {}).get(
                 name, f"{cfg.num_layers}L-tp{r.engine._config.tp}")
-            r.engine.telemetry.set_base_labels(engine=name, model=label)
+            r.engine.telemetry.set_base_labels(engine=name, model=label,
+                                               role=self._roles[name])
+        # the disaggregated fleet's shared KV tier: every prefill
+        # replica's handoff pages must be restorable by some decode/
+        # unified replica, which requires ONE shared KVSwapTier instance
+        # across them (validated loudly — a per-engine tier would make
+        # every handoff silently re-prefill)
+        self._tier = None
+        if self._has_prefill:
+            tiers = {name: r.engine.kv_swap
+                     for name, r in self._replicas.items()}
+            for name, tier in tiers.items():
+                if tier is None:
+                    # a tier-less decode/unified replica would silently
+                    # RE-PREFILL every handoff placed on it (its swap-in
+                    # admission path never runs) — reject it as loudly as
+                    # a tier-less prefill replica
+                    raise ValueError(
+                        f"replica {name!r} (role="
+                        f"{self._roles[name]!r}) has no KV swap tier — "
+                        "attach ONE shared KVSwapTier (attach_kv_tier) "
+                        "to every replica in a disaggregated fleet")
+            shared = {id(t) for t in tiers.values()}
+            if len(shared) != 1 or not any(
+                    self._roles[n] != "prefill" for n in tiers):
+                raise ValueError(
+                    "disaggregated fleet: every replica must share ONE "
+                    "KVSwapTier instance (shared=True) spanning prefill "
+                    "AND decode/unified roles — pages published at "
+                    "handoff must be restorable by the decode side")
+            self._tier = next(t for t in tiers.values() if t is not None)
+            if not self._tier.shared:
+                raise ValueError(
+                    "disaggregated fleet: the shared KVSwapTier must be "
+                    "constructed with shared=True (per-engine pruning "
+                    "would drop peers' in-flight handoff records)")
         # consistent-hash ring over ALL replicas; membership is filtered at
         # lookup so the keyspace split is stable across failures/rejoins
         ring: List[Tuple[int, str]] = []
@@ -224,7 +298,9 @@ class EngineRouter:
             placements=0, failovers=0, reroutes=0, drains=0,
             drain_migrated=0, engine_kills=0, rejoins=0,
             heartbeat_misses=0, requests_failed=0, completions=0,
-            engine_retired=0)
+            engine_retired=0, handoffs=0, handoffs_unpublished=0)
+        self._serve_limit = 32       # serve()'s max_new_tokens default
+        #                              (the classification denominator)
         self.placements_by_engine: Dict[str, int] = {
             name: 0 for name in self._replicas}
         self.last_recovery_ms: float = 0.0
@@ -239,13 +315,17 @@ class EngineRouter:
         return {name: r.status for name, r in self._replicas.items()}
 
     def stats(self) -> Dict:
-        return {
+        out = {
             "counters": dict(self.counters),
             "placements_by_engine": dict(self.placements_by_engine),
             "replicas": self.replica_status(),
+            "roles": dict(self._roles),
             "in_flight": len(self._assignment),
             "last_recovery_ms": self.last_recovery_ms,
         }
+        if self._tier is not None:
+            out["tier"] = dict(self._tier.stats)
+        return out
 
     def render_prometheus(self) -> str:
         """``ds_router_*`` counters plus every replica's ``ds_serving_*``
@@ -261,15 +341,34 @@ class EngineRouter:
             lines.append(f"# TYPE {full} counter")
             lines.append(f"{full} {val}")
             if name == "placements":
+                # engine samples carry the replica's role base label so a
+                # heterogeneous fleet's legs are separable per role
                 for en in sorted(self.placements_by_engine):
-                    lines.append(f'{full}{{engine="{en}"}} '
-                                 f"{self.placements_by_engine[en]}")
+                    lines.append(
+                        f'{full}{{engine="{en}",role='
+                        f'"{self._roles.get(en, "unified")}"}} '
+                        f"{self.placements_by_engine[en]}")
+        if self._tier is not None:
+            # fleet-level shared-tier traffic (any replica's boundary may
+            # drain a peer's queued writes, so these counters live on the
+            # tier, not on one engine's telemetry)
+            for stat, val in sorted(self._tier.stats.items()):
+                full = f"ds_router_tier_{stat}_total"
+                lines.append(f"# TYPE {full} counter")
+                lines.append(f"{full} {val}")
         lines.append("# TYPE ds_router_last_recovery_ms gauge")
         lines.append(f"ds_router_last_recovery_ms {self.last_recovery_ms}")
         lines.append("# TYPE ds_router_replica_up gauge")
         for name, r in sorted(self._replicas.items()):
             up = 1 if r.status in (HEALTHY, DRAINING) else 0
-            lines.append(f'ds_router_replica_up{{engine="{name}"}} {up}')
+            lines.append(f'ds_router_replica_up{{engine="{name}",role='
+                         f'"{self._roles[name]}"}} {up}')
+        lines.append("# TYPE ds_router_prefill_queue_tokens gauge")
+        for name, r in sorted(self._replicas.items()):
+            if self._roles[name] == "prefill":
+                lines.append(
+                    f'ds_router_prefill_queue_tokens{{engine="{name}",'
+                    f'role="prefill"}} {self._prefill_score(r)}')
         # merge by FAMILY, not by concatenation: the text format requires
         # all lines of one metric to form a single group, so every
         # replica's samples for a family are emitted together under one
@@ -357,6 +456,52 @@ class EngineRouter:
             need = len(item[1])
         return need + 2 <= r.engine.max_seq_len
 
+    def _classify(self, item) -> str:
+        """Prefill-heavy vs decode-heavy arrival classification (the
+        disaggregated fleet's placement heuristic). An arrival carrying
+        committed tokens (a handoff or failover resume with
+        ``generated``) is ALWAYS decode-heavy — a token can only exist
+        after full prefill, so its remaining work is streaming. Fresh
+        arrivals classify by prompt length vs generation budget:
+        ``len(prompt) >= prefill_route_ratio * max_new_tokens`` (and at
+        least ``prefill_route_min_prompt``) routes to a prefill replica.
+        Returns "any" for role-blind fleets (no prefill replicas)."""
+        if not self._has_prefill:
+            return "any"
+        if isinstance(item, dict):
+            if item.get("generated"):
+                return "decode"
+            toks = item["tokens"]
+            limit = item.get("max_new_tokens")
+        else:
+            toks = item[1]
+            limit = item[2] if len(item) > 2 and item[2] is not None \
+                else None
+        limit = self._serve_limit if limit is None else limit
+        plen = len(toks)
+        if plen >= self.cfg.prefill_route_min_prompt and \
+                plen >= self.cfg.prefill_route_ratio * max(1, limit):
+            return "prefill"
+        return "decode"
+
+    @staticmethod
+    def _feed_prompt_tokens(r: "_Replica") -> int:
+        t = 0
+        for item in r.feed:
+            if isinstance(item, dict):
+                t += len(item["tokens"]) + len(item.get("generated") or ())
+            else:
+                t += len(item[1])
+        return t
+
+    def _prefill_score(self, r: "_Replica") -> int:
+        """Prefill-replica placement score: queued prompt TOKENS (router
+        feed + the replica's own queue, from its last boundary) — lower
+        is better. Token count, not request count: one 8k prompt is more
+        wide-frame backlog than twenty 64-token ones."""
+        b = r.last_boundary
+        return (b.queued_tokens if b else 0) + self._feed_prompt_tokens(r)
+
     def _pick(self, key: str, exclude: frozenset = frozenset(),
               item=None) -> Optional[str]:
         fits = (lambda r: True) if item is None else \
@@ -370,6 +515,25 @@ class EngineRouter:
                      if r.accepting() and fits(r)}
         if not cands:
             return None
+        # role-aware split (disaggregated fleet): prefill-heavy arrivals
+        # prefer a prefill replica by queued-prompt-token score;
+        # decode-heavy ones prefer decode/unified replicas. Either side
+        # falls back to the other rather than stranding the request —
+        # unified replicas serve anything, and a prefill replica serving
+        # a decode request still makes progress (it hands off one token
+        # further each round trip).
+        role_need = "any" if item is None else self._classify(item)
+        if role_need == "prefill":
+            pcands = {n: r for n, r in cands.items()
+                      if self._roles[n] == "prefill"}
+            if pcands:
+                return min(pcands,
+                           key=lambda n: (self._prefill_score(pcands[n]), n))
+        if role_need in ("prefill", "decode"):
+            dcands = {n: r for n, r in cands.items()
+                      if self._roles[n] != "prefill"}
+            if dcands:
+                cands = dcands
         name = self._ring_pick(key, cands)
         if self.cfg.affinity_overload_score is not None and \
                 self._score(self._replicas[name]) > \
@@ -399,6 +563,7 @@ class EngineRouter:
                 self._assignment.pop(uid, None)
                 self._affinity.pop(uid, None)
                 self._reroute_hops.pop(uid, None)
+                self._drop_tier_record(uid)
                 self.counters["requests_failed"] += 1
                 self.fault_log.append(RouterFault(
                     kind="request_failed", uid=uid, tick=self._tick,
@@ -443,6 +608,7 @@ class EngineRouter:
             # a resubmission of this uid gets a FRESH budget, not the
             # exhausted one
             self._reroute_hops.pop(uid, None)
+            self._drop_tier_record(uid)
             self.counters["requests_failed"] += 1
             self.fault_log.append(RouterFault(
                 kind="request_failed", tick=tick, uid=uid,
@@ -628,6 +794,9 @@ class EngineRouter:
                         self._fail_replica(r, tick, "missed_heartbeat",
                                            hb_fail, snap)
                     break
+                if isinstance(item, HandoffEvent):
+                    self._handle_handoff(r, item, tick)
+                    continue
                 uid, toks = item
                 self._finish(uid)
                 done.append((uid, toks))
@@ -640,6 +809,32 @@ class EngineRouter:
             r.gen = None
             self._fail_replica(r, tick, "engine_crash", str(e), snap)
         return done
+
+    def _handle_handoff(self, r: "_Replica", ev: HandoffEvent,
+                        tick: int) -> None:
+        """A prefill replica finished ``ev.uid``'s prefill: its pages sit
+        in the shared tier and ``ev.arrival`` is the resume arrival — re-
+        place it on the decode side (the classification sees its
+        committed tokens and never routes it back to a prefill replica;
+        session affinity is re-stamped so a session's decode lands with
+        its siblings). Placement failure parks it in ``_unplaced`` like
+        any other arrival — it retries every tick and the in-flight
+        accounting (``_assignment``) keeps serve() from shutting down
+        under it."""
+        self.counters["handoffs"] += 1
+        if not ev.published:
+            self.counters["handoffs_unpublished"] += 1
+        self._assignment.pop(ev.uid, None)
+        self._restamp_affinity([ev.arrival])
+        self._place(ev.arrival)
+
+    def _drop_tier_record(self, uid: int) -> None:
+        """A request failed terminally at the ROUTER (re-route budget /
+        unservable prompt): its handoff pages in the shared tier are now
+        orphaned — release them (engines drop records only for requests
+        they retire themselves)."""
+        if self._tier is not None:
+            self._tier.drop_request(uid)
 
     def _finish(self, uid: int) -> None:
         self._assignment.pop(uid, None)
@@ -704,6 +899,7 @@ class EngineRouter:
         (``faults.snapshot_split``), so greedy outputs are token-identical
         to a no-failure run."""
         cfg = self.cfg
+        self._serve_limit = max_new_tokens   # classification denominator
         serve_kwargs = dict(max_new_tokens=max_new_tokens,
                             temperature=temperature,
                             eos_token_id=eos_token_id,
@@ -815,7 +1011,12 @@ class EngineRouter:
                     except FrameDispatchError:
                         r.gen = None
                         break
-                    if not isinstance(item, ServeBoundary):
+                    if isinstance(item, HandoffEvent):
+                        # unreachable in practice (the main loop only
+                        # exits with zero in-flight requests), but a
+                        # handoff must never be dropped on the floor
+                        self._handle_handoff(r, item, tick)
+                    elif not isinstance(item, ServeBoundary):
                         self._finish(item[0])
                         yield item
                 r.closing = False

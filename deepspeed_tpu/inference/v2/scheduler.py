@@ -233,6 +233,13 @@ class RequestScheduler:
     def queued_uids(self) -> List[int]:
         return [r.uid for q in self._queues.values() for r in q]
 
+    def queued_prompt_tokens(self) -> int:
+        """Prompt tokens waiting across every class/tenant queue — the
+        ``ServeBoundary.queued_tokens`` signal a disaggregated router
+        scores prefill replicas by (a prefill replica's backlog is
+        TOKENS to chew through, not request count)."""
+        return sum(len(r.tokens) for q in self._queues.values() for r in q)
+
     def live_request(self, uid: int) -> Optional[Request]:
         return self._live.get(uid)
 

@@ -173,10 +173,11 @@ class LogBucketHistogram:
 
 class _Span:
     __slots__ = ("uid", "enqueue_t", "admit_t", "first_token_t",
-                 "last_emit_t", "tokens", "tenant", "pclass")
+                 "last_emit_t", "tokens", "tenant", "pclass", "resumed")
 
     def __init__(self, uid: int, enqueue_t: float,
-                 tenant: Optional[str] = None, pclass: Optional[str] = None):
+                 tenant: Optional[str] = None, pclass: Optional[str] = None,
+                 resumed: bool = False):
         self.uid = uid
         self.enqueue_t = enqueue_t
         self.admit_t: Optional[float] = None
@@ -185,6 +186,12 @@ class _Span:
         self.tokens = 0
         self.tenant = tenant        # scheduler metadata (None without one)
         self.pclass = pclass
+        # a resume arrival (router failover / drain migration / prefill→
+        # decode handoff) already emitted its true first token on another
+        # engine: this engine's first emission is a CONTINUATION, not a
+        # TTFT sample — recording it would pollute the fleet-merged TTFT
+        # histograms the disaggregation bench compares
+        self.resumed = resumed
 
 
 class ServingTelemetry:
@@ -257,7 +264,19 @@ class ServingTelemetry:
                              prefix_blocks_swapped_in=0,
                              kv_swap_out_requests=0, kv_swap_out_blocks=0,
                              kv_swap_in_requests=0, kv_swap_in_blocks=0,
-                             kv_swap_resume_restores=0)
+                             kv_swap_resume_restores=0,
+                             # disaggregated prefill/decode fleet
+                             # (router.py roles): requests handed off to a
+                             # decode replica after this engine finished
+                             # their prefill, admissions served from the
+                             # shared tier's content-addressed prefix
+                             # records, and async swap-out commit modes
+                             # (overlapped with the next frame vs forced
+                             # blocking at a lookup)
+                             handoffs_out=0, tier_prefix_hits=0,
+                             tier_prefix_hit_tokens=0,
+                             kv_swap_commits_overlapped=0,
+                             kv_swap_commits_blocking=0)
         self.gauges: Dict[str, float] = {
             "live_slots": 0, "slot_count": 0, "queue_depth": 0,
             "kv_blocks_in_use": 0, "kv_blocks_in_use_peak": 0,
@@ -358,11 +377,13 @@ class ServingTelemetry:
         series[labels] = series.get(labels, 0) + n
 
     def on_enqueue(self, uid: int, tenant: Optional[str] = None,
-                   pclass: Optional[str] = None) -> None:
+                   pclass: Optional[str] = None,
+                   resumed: bool = False) -> None:
         if not self.enabled:
             return
         self.counters["requests_enqueued"] += 1
-        self._open_spans[uid] = _Span(uid, self.clock(), tenant, pclass)
+        self._open_spans[uid] = _Span(uid, self.clock(), tenant, pclass,
+                                      resumed=resumed)
 
     def on_admit(self, uid: int) -> None:
         if not self.enabled:
@@ -393,12 +414,13 @@ class ServingTelemetry:
         now = self.clock()
         if span.first_token_t is None:
             span.first_token_t = now
-            ttft = now - span.enqueue_t
-            self.hists["ttft"].record(ttft)
-            self._win["ttft"].append(ttft)
-            if span.pclass is not None:
-                self.class_ttft.setdefault(
-                    span.pclass, LogBucketHistogram()).record(ttft)
+            if not span.resumed:
+                ttft = now - span.enqueue_t
+                self.hists["ttft"].record(ttft)
+                self._win["ttft"].append(ttft)
+                if span.pclass is not None:
+                    self.class_ttft.setdefault(
+                        span.pclass, LogBucketHistogram()).record(ttft)
         else:
             gap = max(0.0, now - span.last_emit_t)
             self.hists["itl"].record(gap / n_tokens, count=n_tokens)
@@ -539,6 +561,37 @@ class ServingTelemetry:
         self.counters["kv_swap_in_blocks"] += n_blocks
         if resume:
             self.counters["kv_swap_resume_restores"] += 1
+
+    def on_handoff_out(self, uid: int) -> None:
+        """A prefill-role engine finished ``uid``'s prefill, published its
+        pages to the shared tier, and handed the request to the router for
+        decode placement. The span closes WITHOUT latency samples (the
+        request is still in flight — its decode replica owns the rest of
+        its lifecycle; the TTFT recorded at this engine's first emission
+        already stands)."""
+        if not self.enabled:
+            return
+        self.counters["handoffs_out"] += 1
+        self._open_spans.pop(uid, None)
+
+    def on_tier_prefix_hit(self, hit_tokens: int, n_blocks: int) -> None:
+        """An admission restored a content-addressed prefix record from
+        the shared tier (the fleet-wide prefix share)."""
+        if not self.enabled:
+            return
+        self.counters["tier_prefix_hits"] += 1
+        self.counters["tier_prefix_hit_tokens"] += hit_tokens
+        self.counters["kv_swap_in_blocks"] += n_blocks
+
+    def on_kv_swap_commits(self, overlapped: int = 0,
+                           blocking: int = 0) -> None:
+        """Swap-tier record commits since the last boundary, split by mode
+        (overlapped = drained at a frame boundary after riding the aio
+        queue through the previous frame; blocking = forced synchronous)."""
+        if not self.enabled:
+            return
+        self.counters["kv_swap_commits_overlapped"] += overlapped
+        self.counters["kv_swap_commits_blocking"] += blocking
 
     def slo_view(self) -> Dict[str, Optional[float]]:
         """LIVE SLO signal: p90 (ms) over the recent sample windows — the

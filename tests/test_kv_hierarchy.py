@@ -173,6 +173,47 @@ def test_prefix_cache_block_spill_and_restore(tmp_path):
     assert kv.allocator.free_blocks == 7
 
 
+def test_eviction_hot_small_survives_cold_large():
+    """Victim scoring beyond LRU (ISSUE-12 satellite): under pressure a
+    HOT small prefix (frequent hits, one block) outlives a COLD large one
+    (many blocks, zero hits) even when the cold chain was touched more
+    RECENTLY — hit frequency outranks recency, and among equally-cold
+    entries the larger subtree goes first. LRU stays the tie-break."""
+    kv = _tiny_pool()
+    pc = PrefixCache(kv)
+    bs = kv.block_size
+    hot_stream = list(range(bs))
+    hot_blocks = kv.allocator.allocate(1)
+    pc.publish(uid=1, stream=hot_stream, blocks=hot_blocks,
+               upto_tokens=bs)
+    kv.allocator.free(hot_blocks)
+    cold_stream = [100 + t for t in range(3 * bs)]
+    cold_blocks = kv.allocator.allocate(3)
+    pc.publish(uid=2, stream=cold_stream, blocks=cold_blocks,
+               upto_tokens=3 * bs)
+    kv.allocator.free(cold_blocks)
+    # the hot prefix is HIT repeatedly (earlier than the cold touch, so
+    # pure LRU would evict it first)...
+    for _ in range(3):
+        full, _ = pc.match(hot_stream + [9])
+        pc.touch(full, bs)
+    # ...then the cold chain is matched once but never counted as a hit
+    # (touch with hit_tokens=0 stamps recency only)
+    full_cold, _ = pc.match(cold_stream + [9])
+    assert len(full_cold) == 3
+    now = pc._tick()
+    for e in full_cold:
+        e.last_used = now            # most recent — LRU would keep these
+    freed = pc.reclaim(3)
+    assert freed == 3
+    hot_entry, _ = pc.match(hot_stream + [9])
+    assert len(hot_entry) == 1 and hot_entry[0].block is not None, \
+        "the hot small prefix must survive the cold large one"
+    assert pc.match(cold_stream + [9])[0] == [], "the cold chain is gone"
+    pc.clear()
+    assert kv.allocator.free_blocks == 7
+
+
 def test_batched_pressure_spill_io_counts(tmp_path, monkeypatch):
     """``reclaim`` spills N cold blocks as ONE batch: one device gather
     per pool (``read_pages`` on the whole block list), all page writes

@@ -456,11 +456,15 @@ def test_router_prometheus_exposition(engine_pool):
     dict(router.serve(_arrivals(schedule={0: [0]}), max_new_tokens=4))
     text = router.render_prometheus()
     assert "# TYPE ds_router_placements_total counter" in text
-    assert 'ds_router_placements_total{engine="a"}' in text
-    assert 'ds_router_replica_up{engine="a"} 1' in text
-    # per-replica serving series carry the engine/model identity labels
-    assert 'ds_serving_frames_total{engine="a",model="tiny"}' in text \
-        or 'ds_serving_frames_total{engine="b",model="tiny"}' in text
+    # per-engine ds_router_* samples carry the replica ROLE base label
+    # (prefill/decode/unified) so heterogeneous fleets are separable
+    assert 'ds_router_placements_total{engine="a",role="unified"}' in text
+    assert 'ds_router_replica_up{engine="a",role="unified"} 1' in text
+    # per-replica serving series carry the engine/model/role identity
+    assert ('ds_serving_frames_total{engine="a",model="tiny",'
+            'role="unified"}' in text) \
+        or ('ds_serving_frames_total{engine="b",model="tiny",'
+            'role="unified"}' in text)
     # scheduler-style labels merge AFTER the identity labels
     assert "ds_serving_ttft_seconds_bucket{engine=" in text
     # ONE # TYPE line per metric family across the whole fleet, with every
@@ -474,7 +478,7 @@ def test_router_prometheus_exposition(engine_pool):
     (frames_block,) = blocks      # one block holds BOTH replicas' samples
     assert 'engine="a"' in frames_block and 'engine="b"' in frames_block
     for eng in (engine_pool["a"], engine_pool["b"]):
-        eng.telemetry.set_base_labels(engine=None, model=None)
+        eng.telemetry.set_base_labels(engine=None, model=None, role=None)
 
 
 def test_engine_side_retirement_does_not_hang_router(engine_pool):
