@@ -181,6 +181,17 @@ class RaggedInferenceEngineConfig:
     # (fleet-wide prefix share) when the local prefix cache misses; only
     # active when a swap tier is attached and records exist
     tier_prefix_share: bool = True
+    # handoff pipelining (README "Disaggregated prefill/decode"): a
+    # prefill-role row whose remaining prompt fits the next frame will
+    # hand off at the NEXT boundary — publish its final record segment
+    # (including the partial tail block at the current chunk-aligned
+    # watermark) NOW, so the write I/O overlaps the first-token frame
+    # instead of landing on the handoff critical path (the decode
+    # replica's restore blocks on the commit). The record's watermark
+    # stays at the publish point; the decode side replays the sub-frame
+    # tail cold (chunk-aligned, so greedy outputs are token-identical).
+    # False restores the publish-at-handoff behavior.
+    handoff_pipeline: bool = True
     dtype: str = "bfloat16"
 
 
@@ -208,6 +219,13 @@ class ServeBoundary:
     # scheduler queues) — the router's prefill-replica placement signal:
     # a prefill replica's real backlog is prompt TOKENS, not request count
     queued_tokens: int = 0
+    # tokens committed by the frame this boundary closed, per live uid
+    # (the host emit-mask replay the loop already computed) — the service
+    # edge's streaming surface: an SSE front-end forwards these at every
+    # boundary instead of waiting for the final (uid, tokens) yield. None
+    # for an idle (undispatched) boundary; {} when the frame emitted
+    # nothing new.
+    emissions: Optional[Dict[int, List[int]]] = None
 
 
 @dataclasses.dataclass
@@ -472,6 +490,41 @@ class InferenceEngineV2:
         """Cancel a drain (replica kept after all): admission resumes at
         the next frame boundary."""
         self._draining = False
+
+    def set_role(self, role: str) -> None:
+        """Re-label this engine's serving role (the autoscaler's elastic
+        prefill<->decode rebalancing surface). The role is latched at
+        ``serve()`` entry, so a flip takes effect at the replica's NEXT
+        serve generator — the fleet driver restarts the generator after an
+        idle drain, migrating anything queued, exactly like a failover
+        resume (token-identical by the same argument)."""
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role={role!r}: expected 'unified', "
+                             "'prefill' or 'decode'")
+        if role == "prefill" and self.kv_swap is None:
+            raise ValueError(
+                "set_role('prefill') needs a KV swap tier (kv_swap_dir= "
+                "or attach_kv_tier()) — the prefill->decode handoff "
+                "publishes committed pages through it")
+        self._config.role = role
+
+    def cancel_request(self, uid: int) -> bool:
+        """Cancel an accepted, in-flight request (the service edge's
+        client-disconnect path): marks the ledger entry cancelled and
+        expires its deadline, so the NEXT frame boundary's existing
+        deadline machinery cancels it wherever it sits — popped from the
+        queue, or evicted from its live slot with its KV blocks freed —
+        and retires it with a ``cancelled`` FaultReason instead of
+        ``deadline_expired``. Safe to call from another thread while a
+        serve generator runs (it only writes two fields of an existing
+        ledger entry; the boundary does the actual teardown). Returns
+        False when ``uid`` is not in flight (already retired)."""
+        ent = self._ledger.get(uid)
+        if ent is None:
+            return False
+        ent.cancelled = True
+        ent.deadline_at = self._clock()
+        return True
 
     # ------------------------------------------------------------------
     # admission control (reference engine_v2.py:184)
@@ -1286,9 +1339,16 @@ class InferenceEngineV2:
                             break
                 where = "queued (never admitted)"
             self.state.flush_sequence(uid)       # frees any KV blocks
-            self._fault_retire(uid, "deadline_expired", frame,
-                               detail=f"deadline_ms elapsed while {where}",
-                               partial=partial)
+            ent = self._ledger.get(uid)
+            if ent is not None and ent.cancelled:
+                self._fault_retire(uid, "cancelled", frame,
+                                   detail=f"cancel_request() while {where}",
+                                   partial=partial)
+            else:
+                self._fault_retire(uid, "deadline_expired", frame,
+                                   detail=f"deadline_ms elapsed while "
+                                          f"{where}",
+                                   partial=partial)
 
     def _quarantine_rows(self, uids, slots, frame: int, sched=None,
                          escalated: bool = False) -> None:
@@ -1758,15 +1818,36 @@ class InferenceEngineV2:
         seq.tier_blocks = nb
         return nb - start
 
-    def _tier_publish_progress(self, slots, boundary: int) -> None:
+    def _tier_publish_progress(self, slots, boundary: int,
+                               next_steps: int = 1) -> None:
         """Prefill-role boundary publish: every live MID-PREFILL row's
         newly-committed full blocks enter its tier record as one more
         segment (async — the writes overlap with the next frame). A
         replica killed mid-prompt therefore leaves a restorable
         partial-watermark record: the failover peer restores the pages
         and resumes prefill at the watermark instead of from token
-        zero."""
+        zero.
+
+        Handoff PIPELINING (``handoff_pipeline``, README "Disaggregated
+        prefill/decode"): a row whose remaining prompt fits the next
+        frame (``remaining <= chunk * next_steps``) will hand off at the
+        NEXT boundary — so this boundary publishes its FINAL segment
+        (everything below the current chunk-aligned watermark, including
+        a partially-filled tail block) and stamps the handoff metadata.
+        The final segment's write I/O then overlaps the first-token frame
+        instead of landing between the handoff and the decode replica's
+        blocking restore; the handoff boundary itself does zero page I/O.
+        The record's watermark stays at the publish point — the decode
+        side replays the (sub-frame, chunk-aligned) tail cold, exactly
+        the proven partial-watermark failover path, so greedy outputs
+        stay token-identical. A mispredicted handoff (the next frame ran
+        shorter than planned — adaptive sizing or a scheduler pressure
+        cap) is healed here one boundary later: a partial tail block's
+        snapshot is stale above its watermark, so the record is dropped
+        and republished from block zero before any further append."""
         bs = self.kv.block_size
+        chunk = self._config.prefill_chunk_size
+        pipeline = self._config.handoff_pipeline
         for uid, slot in list(slots.slot_of_uid.items()):
             if slots.cached_h[slot] >= slots.plen_h[slot]:
                 continue                       # prefill done: handoff path
@@ -1774,13 +1855,61 @@ class InferenceEngineV2:
             ent = self._ledger.get(uid)
             if seq is None or ent is None or not seq.blocks:
                 continue
-            nb = int(slots.cached_h[slot]) // bs
-            if nb <= seq.tier_blocks or nb > len(seq.blocks):
+            w_cur = int(slots.cached_h[slot])
+            remaining = int(slots.plen_h[slot]) - w_cur
+            if seq.tier_final:
+                # the pipelined final publish predicted a handoff that
+                # did not come: fall back to incremental publishing. A
+                # full-block record is still appendable (just clear the
+                # flags); a partial tail block must be republished from
+                # zero (its snapshot is garbage above the watermark, and
+                # segments are append-only).
+                if seq.tier_partial:
+                    try:
+                        self.kv_swap.drop_request(uid)
+                    except Exception as e:   # noqa: BLE001 — best-effort
+                        self._fault_event(
+                            "swap_failed", boundary,
+                            f"uid={uid}: stale pipelined record drop "
+                            f"failed ({type(e).__name__}: {e})")
+                    seq.tier_blocks = 0
+                seq.tier_final = seq.tier_partial = False
+            final = pipeline and remaining <= chunk * max(1, next_steps)
+            if final:
+                nb, w = self.kv.blocks_for(w_cur), w_cur
+            else:
+                nb = w_cur // bs
+                w = nb * bs
+            if nb > len(seq.blocks):
                 continue
-            w = nb * bs
+            meta = {"prompt_tokens": len(ent.prompt),
+                    "generated": len(seq.generated),
+                    "role": "prefill", "pipelined": True} if final else None
+            if nb <= seq.tier_blocks:
+                if final and seq.tier_blocks == nb and nb > 0:
+                    # no new pages, but the record is now the COMPLETE
+                    # handoff record — stamp the metadata (no page I/O).
+                    # A False return means the record is GONE (a failed
+                    # async drain dropped it): leave tier_final unset so
+                    # the handoff republishes honestly instead of
+                    # claiming a record that does not exist
+                    try:
+                        if self.kv_swap.stamp_request_handoff(uid, meta):
+                            seq.tier_final = True
+                        else:
+                            seq.tier_blocks = 0
+                    except Exception as e:   # noqa: BLE001 — best-effort
+                        self._fault_event(
+                            "swap_failed", boundary,
+                            f"uid={uid}: pipelined handoff stamp failed "
+                            f"({type(e).__name__}: {e})")
+                continue
             stream = self._full_stream(ent, seq)
             try:
-                n_new = self._publish_segments(uid, seq, stream, w, nb)
+                n_new = self._publish_segments(uid, seq, stream, w, nb,
+                                               handoff=meta)
+                seq.tier_final = final
+                seq.tier_partial = final and w < nb * bs
                 if n_new:
                     self.telemetry.on_kv_swap_out(n_new)
             except Exception as e:   # noqa: BLE001 — publish is best-effort
@@ -1833,7 +1962,30 @@ class InferenceEngineV2:
             w = int(slots.cached_h[slot])
             n = self.kv.blocks_for(w)
             published = False
-            if 0 < w < len(stream) + 1 and seq.tier_blocks < n <= \
+            if seq.tier_final:
+                # pipelined handoff: the final segment (and the handoff
+                # metadata) was published at the boundary BEFORE the
+                # first-token frame — the record is complete and
+                # restorable at its own (lower, chunk-aligned) watermark,
+                # and this boundary does zero page I/O. The decode
+                # replica replays the sub-frame tail cold. Refresh only
+                # the generated-token count in the metadata — a False
+                # return means a failed async drain DROPPED the record
+                # after the early publish: report published=False so the
+                # router counts it (handoffs_unpublished) and the decode
+                # side's re-prefill is an accounted fallback, not a
+                # silent one.
+                try:
+                    published = self.kv_swap.stamp_request_handoff(
+                        uid, {"prompt_tokens": len(ent.prompt),
+                              "generated": len(seq.generated),
+                              "role": "prefill", "pipelined": True})
+                except Exception as e:   # noqa: BLE001 — metadata only
+                    self._fault_event(
+                        "swap_failed", boundary,
+                        f"uid={uid}: pipelined handoff stamp failed "
+                        f"({type(e).__name__}: {e})")
+            elif 0 < w < len(stream) + 1 and seq.tier_blocks < n <= \
                     len(seq.blocks):
                 try:
                     n_new = self._publish_segments(
@@ -1867,14 +2019,16 @@ class InferenceEngineV2:
                             f"uid={uid}: tier prefix publish failed "
                             f"({type(e).__name__}: {e})")
             item = self._handoff_arrival(uid, ent, seq)
+            pipelined = seq.tier_final
             slots.evict(uid)
             if sched is not None:
                 sched.on_retire(uid)
             self.state.flush_sequence(uid)
             self._ledger.pop(uid, None)
-            self.telemetry.on_handoff_out(uid)
+            self.telemetry.on_handoff_out(uid, pipelined=pipelined)
             logger.info(f"serve(): uid={uid} handed off at boundary "
-                        f"{boundary} (watermark={w}, published={published})")
+                        f"{boundary} (watermark={w}, published={published}, "
+                        f"pipelined={pipelined})")
             out.append(HandoffEvent(uid=uid, arrival=item,
                                     published=published))
         return out
@@ -2070,7 +2224,7 @@ class InferenceEngineV2:
                     slots.committed_h[slots.slot_of_uid[uid]])
                 tel.on_emit(uid, len(new_toks))
             if self._handoff_mode:
-                self._tier_publish_progress(slots, boundary)
+                self._tier_publish_progress(slots, boundary, cur_steps)
             self._publish_prefixes(slots)
             for uid in finished:
                 seq = self.state.seqs[uid]
@@ -2093,7 +2247,8 @@ class InferenceEngineV2:
                     index=boundary, dispatched=True,
                     live=slots.live_count(), queued=len(pending),
                     free_slots=slots.free_slots(), t=self._clock(),
-                    queued_tokens=sum(len(p[1]) for p in pending))
+                    queued_tokens=sum(len(p[1]) for p in pending),
+                    emissions=emissions)
 
     # ------------------------------------------------------------------
     # SLO-aware scheduled serving (scheduler.RequestScheduler)
@@ -2155,6 +2310,7 @@ class InferenceEngineV2:
         # stale block offset while claiming the full watermark (silently
         # corrupt pages on the decode side's restore)
         seq.tier_blocks = 0
+        seq.tier_final = seq.tier_partial = False
         if seq.blocks:
             self.kv.allocator.free(seq.blocks)
             seq.blocks = []
@@ -2398,7 +2554,7 @@ class InferenceEngineV2:
                     slots.committed_h[slots.slot_of_uid[uid]])
                 tel.on_emit(uid, len(new_toks))
             if self._handoff_mode:
-                self._tier_publish_progress(slots, boundary)
+                self._tier_publish_progress(slots, boundary, cur_steps)
             self._publish_prefixes(slots)
             for uid in finished:
                 seq = self.state.seqs[uid]
@@ -2419,7 +2575,8 @@ class InferenceEngineV2:
                     index=boundary, dispatched=True,
                     live=slots.live_count(), queued=sched.queued_count(),
                     free_slots=slots.free_slots(), t=self._clock(),
-                    queued_tokens=sched.queued_prompt_tokens())
+                    queued_tokens=sched.queued_prompt_tokens(),
+                    emissions=emissions)
 
     def serialize(self, path: str):
         """Analog of ``engine_v2.py:251`` — snapshot params for fast reload."""

@@ -130,6 +130,10 @@ class LedgerEntry:
     priority: Optional[object] = None      # class name / int, as submitted
     slo_ms: Optional[float] = None
     resumed_from: int = 0      # committed tokens carried across a resume
+    # client-side cancellation (engine.cancel_request — the service edge's
+    # disconnect path): rides the deadline machinery but retires with a
+    # ``cancelled`` FaultReason, not ``deadline_expired``
+    cancelled: bool = False
 
 
 @dataclasses.dataclass

@@ -38,9 +38,11 @@ admission replays the exact chunk boundaries a cold prefill would use.
 """
 
 import dataclasses
+import functools
 import hashlib
 import json
 import os
+import threading
 from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -49,6 +51,20 @@ from ...runtime.swap_tensor.swapper import AsyncTensorSwapper
 from ...utils.logging import logger
 
 CHAIN_ROOT = -1          # parent id of depth-0 prefix blocks
+
+
+def _locked(fn):
+    """Serialize one ``KVSwapTier``'s public surface: a SHARED tier is hit
+    from every replica's worker thread under the threaded fleet driver
+    (``service/fleet.py``) — concurrent boundary drains, handoff publishes
+    and restores would otherwise race on the pending-commit queue and the
+    index. Reentrant (internal cross-calls like restore -> drain keep
+    working); uncontended — hence free — under the serial router driver."""
+    @functools.wraps(fn)
+    def wrapper(self, *a, **kw):
+        with self._lock:
+            return fn(self, *a, **kw)
+    return wrapper
 
 
 def token_fingerprint(tokens: Sequence[int]) -> str:
@@ -459,6 +475,7 @@ class KVSwapTier:
                  prefix_max_records: Optional[int] = 256):
         self.swapper = AsyncTensorSwapper(swap_dir, aio_handle)
         self.shared = shared
+        self._lock = threading.RLock()
         self.prefix_max_records = prefix_max_records
         self._index_path = os.path.join(swap_dir, "kv_tier_index.json")
         self._index = {"requests": {}, "blocks": {}, "prefixes": {}}
@@ -493,9 +510,11 @@ class KVSwapTier:
 
     # ---------------- async commit queue (overlapped swap-out) ----------
 
+    @_locked
     def pending_commits(self) -> int:
         return len(self._pending)
 
+    @_locked
     def drain(self, blocking: bool = True) -> int:
         """Commit every queued async record write: ONE ``swapper.wait``
         finalizes the page files, then the records enter the index with a
@@ -663,6 +682,7 @@ class KVSwapTier:
     def _seg_prefix(uid: int, i: int) -> str:
         return f"kvreq_{uid}_s{i}"
 
+    @_locked
     def put_request(self, uid: int, tokens: int, kv, blocks: List[int],
                     draft_kv=None, fingerprint: Optional[str] = None,
                     async_commit: bool = False,
@@ -688,6 +708,7 @@ class KVSwapTier:
         self._stage("requests", str(uid), rec, async_commit)
         self.stats["requests_out"] += 1
 
+    @_locked
     def publish_request_segment(self, uid: int, tokens: int,
                                 fingerprint: Optional[str], kv,
                                 new_blocks: List[int], draft_kv=None,
@@ -739,10 +760,34 @@ class KVSwapTier:
         self.stats["requests_out"] += 1
         return True
 
+    @_locked
+    def stamp_request_handoff(self, uid: int, handoff: Dict) -> bool:
+        """Attach/refresh the ``handoff`` metadata dict on an EXISTING
+        request record without any page I/O — the pipelined handoff's
+        completion step (engine ``handoff_pipeline``): the record's
+        segments were already published during the first-token frame, so
+        the handoff boundary only stamps the metadata. Works on a
+        still-queued (async, uncommitted) record too. Returns False when
+        no record exists for ``uid``."""
+        key = str(uid)
+        stamped = False
+        for s, k, rec in self._pending:
+            if s == "requests" and k == key:
+                rec["handoff"] = dict(handoff)
+                stamped = True
+        rec = self._index["requests"].get(key)
+        if rec is not None:
+            rec["handoff"] = dict(handoff)
+            self._save_index()
+            stamped = True
+        return stamped
+
+    @_locked
     def request_record(self, uid: int) -> Optional[Dict]:
         self._drain_for_read()
         return self._index["requests"].get(str(uid))
 
+    @_locked
     def restore_request(self, uid: int, kv, dst_blocks: List[int],
                         draft_kv=None) -> None:
         self._drain_for_read()
@@ -764,6 +809,7 @@ class KVSwapTier:
                 off += n
         self.stats["requests_in"] += 1
 
+    @_locked
     def drop_request(self, uid: int) -> None:
         key = str(uid)
         pend = [r for (s, k, r) in self._pending
@@ -782,6 +828,7 @@ class KVSwapTier:
                 self._drop(self._seg_prefix(uid, i), seg)
         self._save_index()
 
+    @_locked
     def prune_requests(self, keep_uids) -> int:
         """Drop request records for uids NOT in ``keep_uids`` (serve()
         start: records exist solely for swap-in re-admission, so a new
@@ -800,6 +847,7 @@ class KVSwapTier:
 
     # ---------------- prefix records (fleet-wide prefix share) ----------
 
+    @_locked
     def put_prefix(self, tokens: Sequence[int], kv, blocks: List[int],
                    draft_kv=None, async_commit: bool = True) -> bool:
         """Publish a CONTENT-ADDRESSED prefix record: pages covering
@@ -829,6 +877,7 @@ class KVSwapTier:
         self.stats["prefix_records"] += 1
         return True
 
+    @_locked
     def match_prefix(self, tokens: Sequence[int], chunk: int,
                      max_probes: int = 64
                      ) -> Optional[Tuple[str, Dict]]:
@@ -855,6 +904,7 @@ class KVSwapTier:
             probes += 1
         return None
 
+    @_locked
     def restore_prefix(self, key: str, kv, dst_blocks: List[int],
                        draft_kv=None) -> None:
         """Restore the FIRST ``len(dst_blocks)`` pages of a prefix record
@@ -893,6 +943,7 @@ class KVSwapTier:
                 draft_kv.k, draft_kv.v, dst_blocks, dkp, dvp)
         self.stats["blocks_in"] += n
 
+    @_locked
     def drop_prefix(self, key: str) -> None:
         self._pending = [(s, k, r) for (s, k, r) in self._pending
                          if not (s == "prefixes" and k == key)]
@@ -904,12 +955,14 @@ class KVSwapTier:
 
     # ---------------- block records (prefix-cache spill) ----------------
 
+    @_locked
     def put_block(self, key: str, kv, block: int, draft_kv=None) -> None:
         self._index["blocks"][key] = self._put(key, kv, [block],
                                                draft_kv=draft_kv)
         self._save_index()
         self.stats["blocks_out"] += 1
 
+    @_locked
     def put_blocks(self, keys: List[str], kv, blocks: List[int],
                    draft_kv=None) -> None:
         """Batched prefix-block spill (``PrefixCache.reclaim`` under
@@ -940,6 +993,7 @@ class KVSwapTier:
         self._save_index()                   # one index rewrite
         self.stats["blocks_out"] += len(keys)
 
+    @_locked
     def restore_block(self, key: str, kv, dst_block: int,
                       draft_kv=None) -> None:
         # pop the record only AFTER a successful restore: a failed read
@@ -953,6 +1007,7 @@ class KVSwapTier:
         self._save_index()
         self.stats["blocks_in"] += 1
 
+    @_locked
     def drop_block(self, key: str) -> None:
         rec = self._index["blocks"].pop(str(key), None)
         if rec is None:

@@ -46,6 +46,15 @@ class DSSequenceDescriptor:
     # boundary-incremental publish cursor (kv_hierarchy
     # ``publish_request_segment``)
     tier_blocks: int = 0
+    # handoff pipelining (engine ``handoff_pipeline``): the FINAL record
+    # segment was already published at the boundary BEFORE the first-token
+    # frame (its write I/O overlaps that frame) — the handoff boundary
+    # does no page I/O. ``tier_partial`` marks a final publish whose tail
+    # block was only partially committed (its snapshot is stale above the
+    # record watermark, so a mispredicted handoff must republish from
+    # block zero rather than append past it).
+    tier_final: bool = False
+    tier_partial: bool = False
 
     @property
     def in_prefill(self) -> bool:

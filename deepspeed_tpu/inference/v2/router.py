@@ -92,6 +92,15 @@ DEAD = "dead"                # failed past max_engine_failures — never rejoins
 @dataclasses.dataclass
 class RouterConfig:
     """Policy knobs for ``EngineRouter`` (see module docstring)."""
+    # which driver advances the fleet (README "Service edge"):
+    #   "serial"   — the cooperative single-thread stepping loop below
+    #                (deterministic; the chaos-test driver);
+    #   "threaded" — service.fleet.FleetDriver: one worker thread per
+    #                replica advances its serve generator concurrently,
+    #                boundary events flow back to a router thread that
+    #                keeps placement/failover/heartbeat semantics
+    #                identical. serve() dispatches on this flag.
+    driver: str = "serial"
     # consistent-hash ring: virtual nodes per replica (more = smoother
     # keyspace split, slightly larger ring)
     ring_replicas: int = 64
@@ -193,12 +202,20 @@ class _Replica:
     def feed_iter(self):
         """The engine-side arrival iterator: each frame boundary drains
         whatever the router placed since the last poll; StopIteration only
-        when the router is shutting this replica down."""
+        when the router is shutting this replica down. Drains by popleft
+        (atomic per item) rather than snapshot-then-clear, so an item
+        appended mid-drain is never silently dropped — the contract the
+        threaded fleet driver's mailbox relies on (identical behavior
+        under the serial driver, which never appends mid-drain)."""
         while True:
             if self.closing and not self.feed:
                 return
-            batch = list(self.feed)
-            self.feed.clear()
+            batch = []
+            while True:
+                try:
+                    batch.append(self.feed.popleft())
+                except IndexError:
+                    break
             yield batch
 
     def accepting(self) -> bool:
@@ -298,7 +315,10 @@ class EngineRouter:
             placements=0, failovers=0, reroutes=0, drains=0,
             drain_migrated=0, engine_kills=0, rejoins=0,
             heartbeat_misses=0, requests_failed=0, completions=0,
-            engine_retired=0, handoffs=0, handoffs_unpublished=0)
+            engine_retired=0, handoffs=0, handoffs_unpublished=0,
+            # autoscaling controller (service/autoscale.py): exported as
+            # the ds_router_scale_* series
+            scale_up=0, scale_down=0, scale_role_flips=0)
         self._serve_limit = 32       # serve()'s max_new_tokens default
         #                              (the classification denominator)
         self.placements_by_engine: Dict[str, int] = {
@@ -730,6 +750,69 @@ class EngineRouter:
             raise KeyError(f"unknown replica {name!r}")
         self._pending_drains.add(name)
 
+    def rejoin_replica(self, name: str) -> bool:
+        """Return a DRAINED (or CLOSED) replica to service — the
+        autoscaler's scale-UP surface (``service/autoscale.py``): a
+        drained replica parks warm (weights resident, generator closed)
+        and rejoins here with a fresh serve generator at the driver's
+        next tick. DEAD replicas never rejoin (the strike budget is a
+        health verdict, not a capacity knob). Returns whether the status
+        changed."""
+        r = self._replicas.get(name)
+        if r is None:
+            raise KeyError(f"unknown replica {name!r}")
+        if r.status not in (DRAINED, CLOSED):
+            return False
+        self._pending_drains.discard(name)
+        r.status = HEALTHY
+        return True
+
+    def validate_replica_role(self, name: str, role: str) -> None:
+        """Raise if re-labeling ``name`` to ``role`` would violate the
+        disaggregated-fleet invariants the constructor enforces: a
+        prefill replica needs the fleet's one shared tier, and flipping
+        the last non-prefill replica away would strand every handoff.
+        Pure check — the fleet driver pre-validates a flip HERE before
+        halting the replica's worker (a post-halt rejection would have
+        paid the generator restart for nothing)."""
+        r = self._replicas.get(name)
+        if r is None:
+            raise KeyError(f"unknown replica {name!r}")
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role={role!r}")
+        if role == "prefill":
+            tier = r.engine.kv_swap
+            if tier is None or not getattr(tier, "shared", False):
+                raise ValueError(
+                    f"replica {name!r}: role='prefill' needs the fleet's "
+                    "shared KVSwapTier attached (attach_kv_tier)")
+            if self._tier is not None and tier is not self._tier:
+                raise ValueError(
+                    f"replica {name!r}: prefill role must share the "
+                    "fleet's one KVSwapTier instance")
+            if all(self._roles[n] == "prefill" or n == name
+                   or self._replicas[n].status == DEAD
+                   for n in self._roles):
+                # DEAD replicas never rejoin, so they are not decode
+                # capacity — a fleet whose only non-prefill peers are
+                # dead would ping-pong every decode request one token
+                # per handoff round
+                raise ValueError(
+                    f"replica {name!r}: flipping the last live "
+                    "non-prefill replica would strand every handoff")
+
+    def set_replica_role(self, name: str, role: str) -> None:
+        """Re-label a replica's role in the router's placement tables
+        AFTER its engine's ``set_role`` (the autoscaler's prefill<->decode
+        flip); validates first (``validate_replica_role``)."""
+        self.validate_replica_role(name, role)
+        r = self._replicas[name]
+        if role == "prefill":
+            self._tier = r.engine.kv_swap
+        self._roles[name] = role
+        self._has_prefill = any(v == "prefill" for v in self._roles.values())
+        r.engine.telemetry.set_base_labels(role=role)
+
     def _begin_drain(self, name: str, tick: int) -> None:
         r = self._replicas[name]
         if r.status != HEALTHY:
@@ -897,7 +980,32 @@ class EngineRouter:
         one frame boundary → handle drains/rejoins. All failover
         re-admission flows through resume arrivals
         (``faults.snapshot_split``), so greedy outputs are token-identical
-        to a no-failure run."""
+        to a no-failure run.
+
+        With ``RouterConfig(driver="threaded")`` this delegates to the
+        thread-per-replica ``service.fleet.FleetDriver`` — same arrival
+        contract, same policy state, same (uid, tokens) stream, with
+        every replica's frames overlapping on its own worker thread.
+        The serial loop below stays the deterministic chaos driver."""
+        if self.cfg.driver == "threaded":
+            from .service.fleet import FleetDriver
+            return FleetDriver(self).serve(
+                arrivals, max_new_tokens=max_new_tokens,
+                temperature=temperature, eos_token_id=eos_token_id,
+                scheduler_factory=scheduler_factory, faults=faults,
+                engine_kwargs=engine_kwargs)
+        if self.cfg.driver != "serial":
+            raise ValueError(f"RouterConfig.driver={self.cfg.driver!r}: "
+                             "expected 'serial' or 'threaded'")
+        return self._serve_serial(
+            arrivals, max_new_tokens=max_new_tokens, temperature=temperature,
+            eos_token_id=eos_token_id, scheduler_factory=scheduler_factory,
+            faults=faults, engine_kwargs=engine_kwargs)
+
+    def _serve_serial(self, arrivals, *, max_new_tokens=32, temperature=0.0,
+                      eos_token_id=None, scheduler_factory=None, faults=None,
+                      engine_kwargs=None):
+        """The cooperative single-thread stepping loop (see ``serve``)."""
         cfg = self.cfg
         self._serve_limit = max_new_tokens   # classification denominator
         serve_kwargs = dict(max_new_tokens=max_new_tokens,

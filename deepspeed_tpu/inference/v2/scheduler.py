@@ -108,6 +108,21 @@ class SchedulerConfig:
     preemption: bool = True
     max_preempts_per_frame: int = 1
     shed_log_max: int = 256
+    # admission LOOKAHEAD (ROADMAP near-term item): reserve free slots for
+    # EWMA-predicted interactive arrivals, so a batch/best-effort burst
+    # that lands an instant before a predicted chat arrival cannot fill
+    # the frame and force a preemption (or a frame of queue-wait) the
+    # prediction could have avoided. Per boundary the scheduler tracks an
+    # EWMA of fresh interactive submissions; ``ceil(ewma)`` slots (capped
+    # by ``lookahead_max_reserve``, and always leaving at least one slot
+    # admissible) are then invisible to effective-batch/best-effort
+    # admissions. Interactive and AGED requests ignore the reserve
+    # (anti-starvation outranks lookahead, exactly as it outranks
+    # deferral). Off by default: reserving slots trades batch throughput
+    # for interactive TTFT.
+    lookahead_reserve: bool = False
+    lookahead_ewma_alpha: float = 0.25
+    lookahead_max_reserve: int = 2
 
     def __post_init__(self):
         if self.aging_frames < 1:
@@ -117,6 +132,10 @@ class SchedulerConfig:
         if self.tenant_max_live is not None and self.tenant_max_live < 1:
             raise ValueError("tenant_max_live must be >= 1 (0 would deadlock "
                              "an idle table against its own quota)")
+        if not 0.0 < self.lookahead_ewma_alpha <= 1.0:
+            raise ValueError("lookahead_ewma_alpha must be in (0, 1]")
+        if self.lookahead_max_reserve < 0:
+            raise ValueError("lookahead_max_reserve must be >= 0")
         if not (self.slo_defer_threshold <= self.slo_shed_threshold):
             raise ValueError("slo_defer_threshold must be <= "
                              "slo_shed_threshold (defer is the milder action)")
@@ -207,6 +226,11 @@ class RequestScheduler:
         self._round = 0
         self.risk = 0.0
         self.pressure = 0          # 0 ok / 1 defer / 2 shed
+        # admission lookahead: fresh interactive submissions since the
+        # last boundary, and their per-boundary EWMA (the slot-reserve
+        # predictor)
+        self._ia_seen = 0
+        self._ia_ewma = 0.0
 
     def begin_serve(self, engine) -> None:
         """Bind to an engine for one serve run (called by ``serve()``)."""
@@ -300,6 +324,10 @@ class RequestScheduler:
         req.seq_no = self._seq_no
         self._seq_no += 1
         req.round0 = self._round
+        if req.priority == INTERACTIVE and not req.resumed:
+            # lookahead predictor input: fresh interactive demand (resumes
+            # are failover bookkeeping, not new arrival-rate signal)
+            self._ia_seen += 1
         key = (req.priority, req.tenant)
         self._queues.setdefault(key, deque()).append(req)
         self._queued_uids.add(req.uid)
@@ -367,6 +395,12 @@ class RequestScheduler:
         telemetry)."""
         cfg = self.cfg
         self._round += 1
+        # admission-lookahead predictor: EWMA of fresh interactive
+        # submissions per boundary (updated even when the feature is off,
+        # so flipping it on mid-run predicts from live history)
+        self._ia_ewma = cfg.lookahead_ewma_alpha * self._ia_seen + \
+            (1.0 - cfg.lookahead_ewma_alpha) * self._ia_ewma
+        self._ia_seen = 0
         # SLO pressure
         self.risk = 0.0
         target = self._slo_target_ms()
@@ -412,6 +446,19 @@ class RequestScheduler:
             return max_steps
         from .kv_cache import BlockedKVCache
         return BlockedKVCache.floor_pow2(max(1, max_steps >> self.pressure))
+
+    def lookahead_reserved(self, free_slots: int) -> int:
+        """Slots this boundary holds back for EWMA-predicted interactive
+        arrivals (``lookahead_reserve``; 0 when off or idle). Never
+        reserves the last admissible slot — with zero interactive demand
+        ever arriving the reserve must not starve batch work outright
+        (the EWMA also decays it to zero within a few boundaries)."""
+        cfg = self.cfg
+        if not cfg.lookahead_reserve or free_slots <= 1 \
+                or self._ia_ewma < 0.5:
+            return 0
+        want = int(np.ceil(self._ia_ewma - 1e-9))
+        return max(0, min(want, cfg.lookahead_max_reserve, free_slots - 1))
 
     # ------------------------------------------------------------------
     # preemption
@@ -494,8 +541,14 @@ class RequestScheduler:
         blocked: set = set()
         first_blocked_uid: Optional[int] = None
         defer_lo = self.pressure >= 1 and live_count > 0
+        reserve = self.lookahead_reserved(free_slots)
         for eff in range(N_PRIORITIES):
-            while len(admits) < free_slots:
+            # admission lookahead: effective-batch/best-effort admissions
+            # cannot take the slots reserved for predicted interactive
+            # arrivals; interactive (and aged-to-interactive) work ignores
+            # the reserve
+            cap = free_slots if eff == INTERACTIVE else free_slots - reserve
+            while len(admits) < cap:
                 best = None
                 for (cls, tenant), q in self._queues.items():
                     if not q or (cls, tenant) in blocked:
@@ -566,6 +619,7 @@ class RequestScheduler:
                                if n},
             "risk": round(self.risk, 4),
             "pressure": self.pressure,
+            "interactive_arrival_ewma": round(self._ia_ewma, 4),
             "admitted_by_class": dict(self.summary["admitted_by_class"]),
             "shed_by_class": dict(self.summary["shed_by_class"]),
             "shed_total": sum(self.summary["shed_by_class"].values()),

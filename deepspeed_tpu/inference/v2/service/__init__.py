@@ -1,0 +1,29 @@
+"""Service edge for the serving fleet (README "Service edge").
+
+The layers below this package make a crash-safe, schedulable,
+disaggregated FLEET — but a fleet is not a *service* until traffic can
+reach it concurrently over a wire. This package is that top layer, the
+MII serving tier of the reference stack (arXiv 2207.00032):
+
+* ``fleet``     — ``FleetDriver``: thread-per-replica driver speaking the
+                  ``ServeBoundary`` protocol; each replica's serve
+                  generator advances on its own worker thread while a
+                  router thread keeps placement/failover/heartbeat
+                  semantics identical to the serial loop (which remains
+                  the deterministic chaos driver; ``RouterConfig(
+                  driver="threaded")`` selects this one).
+* ``edge``      — ``ServiceEdge``: stdlib HTTP/SSE streaming front-end
+                  (``POST /v1/generate``) with fleet-edge admission
+                  control (shed/429 + ``Retry-After`` before any
+                  replica's scheduler sheds locally).
+* ``autoscale`` — ``AutoscaleController``: closes the loop over
+                  ``drain()``/rejoin and flips unified replicas
+                  prefill<->decode from queued-prompt-token pressure.
+"""
+
+from .autoscale import AutoscaleConfig, AutoscaleController
+from .edge import EdgeConfig, ServiceEdge
+from .fleet import FleetConfig, FleetDriver
+
+__all__ = ["AutoscaleConfig", "AutoscaleController", "EdgeConfig",
+           "ServiceEdge", "FleetConfig", "FleetDriver"]

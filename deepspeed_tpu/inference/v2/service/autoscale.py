@@ -1,0 +1,225 @@
+"""Autoscaling / role-rebalancing controller for the fleet driver.
+
+Closes the loop the ROADMAP left open (item 2(d), lineage: the reference
+repo's ``elasticity/`` module): the fleet already has every actuator —
+``drain()`` parks a replica warm (live rows finish, queue migrates,
+weights stay resident), ``rejoin_replica()`` returns it, and the PR-11
+follow-up's prefill<->decode flip is ``FleetDriver.request_role_flip``
+(idle-drain + ``engine.set_role`` + fresh generator, queue migrated like
+a failover — token-identical by the same argument). This module is the
+sensor+policy half: a deterministic, tick-driven controller the driver
+calls on its router thread (no extra threads — directly unit-testable by
+calling ``on_tick`` with scripted state).
+
+Three control laws, each requiring its signal to hold for ``sustain``
+consecutive evaluations (hysteresis against boundary-to-boundary noise):
+
+* **scale down** — every live replica idle (no live rows, nothing
+  queued anywhere): drain one (capacity is wasted heat). Never below
+  ``min_live_replicas``. Idleness is judged by OCCUPANCY, never by
+  ``placement_score`` — the score's latency term holds the last
+  traffic's TTFT window forever on a quiet fleet.
+* **scale up** — parked capacity exists and arrivals sit unplaced,
+  fleet-wide queued tokens exceed the watermark, or even the
+  least-loaded replica's score is past ``scale_up_score``: rejoin one
+  replica this controller previously drained.
+* **role flip** (disaggregated fleets) — queued prompt tokens per
+  prefill replica past ``flip_prefill_high``: flip one idle
+  unified/decode replica (with the shared tier attached) to prefill;
+  when the prefill backlog drains back to ``flip_back_low``, flip it
+  back to its original role. Only replicas this controller flipped are
+  ever flipped back — operator-pinned topology is not second-guessed.
+
+Every action lands in ``events`` and the router's ``scale_up`` /
+``scale_down`` / ``scale_role_flips`` counters (exported as the
+``ds_router_scale_*`` series on the dashboard's autoscaling panel).
+"""
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ....utils.logging import logger
+from ..router import HEALTHY
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Controller knobs (see module docstring)."""
+    # evaluation cadence in WALL-CLOCK seconds, not ticks: the router
+    # thread ticks orders of magnitude faster than frames, so a tick
+    # cadence would evaluate (and exhaust its hysteresis) before the
+    # fleet's state meaningfully changed
+    evaluate_every_s: float = 0.25
+    sustain: int = 2
+    # scale-up pressure: rejoin parked capacity when arrivals sit
+    # unplaced/deferred, fleet-wide queued prompt tokens exceed this, or
+    # even the least-loaded replica's slot occupancy is past
+    # scale_up_occupancy (occupancy, not placement_score: the score's
+    # latency term holds stale TTFT windows on quiet fleets)
+    scale_up_queued_tokens: int = 256
+    scale_up_occupancy: float = 0.85
+    min_live_replicas: int = 1
+    # prefill<->decode rebalancing (inert without a disaggregated fleet
+    # unless pressure creates one: a unified replica can be flipped)
+    role_flip: bool = True
+    flip_prefill_high: int = 256      # queued prompt tokens per prefill
+    flip_back_low: int = 0
+    min_decode_replicas: int = 1
+    # a replica is not flipped again within this many seconds of its last
+    # flip (dwell hysteresis: backlog readings flap around a fresh flip
+    # while the handed-off work redistributes)
+    flip_dwell_s: float = 2.0
+
+
+class AutoscaleController:
+    """See module docstring. One instance per ``FleetDriver``."""
+
+    def __init__(self, config: Optional[AutoscaleConfig] = None):
+        self.cfg = config or AutoscaleConfig()
+        self.events: List[Dict] = []
+        self._parked: List[str] = []       # names this controller drained
+        self._flipped: Dict[str, str] = {}  # name -> original role
+        self._flip_t: Dict[str, float] = {}  # name -> last flip clock
+        self._down_streak = 0
+        self._up_streak = 0
+        self._flip_streak = 0
+        self._back_streak = 0
+        self._last_eval = None
+
+    def _note(self, tick: int, action: str, replica: str,
+              detail: str) -> None:
+        self.events.append(dict(tick=tick, action=action, replica=replica,
+                                detail=detail))
+        logger.warning(f"autoscale: {action} {replica} at tick {tick} "
+                       f"({detail})")
+
+    @staticmethod
+    def _idle(driver, name: str) -> bool:
+        r = driver.router._replicas[name]
+        b = r.last_boundary
+        return (b is not None and b.live == 0 and b.queued == 0
+                and not len(r.feed))
+
+    def on_tick(self, driver, tick: int) -> None:
+        cfg = self.cfg
+        now = driver._clock()
+        if self._last_eval is not None and \
+                now - self._last_eval < cfg.evaluate_every_s:
+            return
+        self._last_eval = now
+        rt = driver.router
+        live = {n: r for n, r in rt._replicas.items()
+                if r.status == HEALTHY}
+        if not live:
+            return
+        queued = driver.queued_tokens_estimate()
+        backlog = bool(rt._unplaced) or bool(rt._deferred)
+
+        def occupancy(r):
+            b = r.last_boundary
+            if b is None:
+                return 0.0
+            slots = max(1, b.live + b.free_slots)
+            return (b.live + b.queued + len(r.feed)) / slots
+
+        # ---- scale up: rejoin parked capacity under pressure ----
+        occs = {n: occupancy(r) for n, r in live.items()}
+        want_up = bool(self._parked) and (
+            backlog or queued > cfg.scale_up_queued_tokens
+            or min(occs.values()) > cfg.scale_up_occupancy)
+        self._up_streak = self._up_streak + 1 if want_up else 0
+        if self._up_streak >= cfg.sustain:
+            self._up_streak = 0
+            # pop only on SUCCESS: a replica still DRAINING (rejoin
+            # returns False) must stay parked and be retried once its
+            # drain completes — popping first would leak it forever
+            name = self._parked[0]
+            status = rt.replica_status()[name]
+            if rt.rejoin_replica(name):
+                self._parked.pop(0)
+                rt.counters["scale_up"] += 1
+                self._note(tick, "scale_up", name,
+                           f"queued_tokens={queued} min_occupancy="
+                           f"{min(occs.values()):.2f}")
+            elif status in ("healthy", "dead"):
+                # already back (someone else rejoined it) or never coming
+                # back — either way it is not parked capacity anymore
+                self._parked.pop(0)
+
+        # ---- scale down: drain waste heat. Idleness is OCCUPANCY, not
+        # placement_score — the score's latency term holds the last
+        # traffic's (compile-inflated) TTFT window forever on a quiet
+        # fleet, so a score watermark would never clear ----
+        want_down = (len(live) > cfg.min_live_replicas and queued == 0
+                     and not backlog
+                     and all(self._idle(driver, n) for n in live))
+        self._down_streak = self._down_streak + 1 if want_down else 0
+        if self._down_streak >= cfg.sustain:
+            self._down_streak = 0
+            idle = sorted(n for n in live if self._idle(driver, n))
+            if idle:
+                name = idle[-1]       # highest name: deterministic victim
+                rt.drain(name)
+                self._parked.append(name)
+                rt.counters["scale_down"] += 1
+                self._note(tick, "scale_down", name,
+                           "fleet idle (no live rows, nothing queued)")
+
+        # ---- role rebalancing (prefill <-> decode) ----
+        if not cfg.role_flip:
+            return
+        prefill = [n for n in live if rt._roles[n] == "prefill"]
+        others = [n for n in live if rt._roles[n] != "prefill"]
+        ptoks = sum(rt._prefill_score(rt._replicas[n]) for n in prefill) \
+            if prefill else sum(
+                rt._replicas[n].last_boundary.queued_tokens
+                for n in others
+                if rt._replicas[n].last_boundary is not None)
+        per_prefill = ptoks / max(1, len(prefill))
+        tier = rt._tier or next(
+            (r.engine.kv_swap for r in live.values()
+             if r.engine.kv_swap is not None
+             and getattr(r.engine.kv_swap, "shared", False)), None)
+        want_flip = (tier is not None
+                     and per_prefill > cfg.flip_prefill_high
+                     and len(others) > cfg.min_decode_replicas)
+        self._flip_streak = self._flip_streak + 1 if want_flip else 0
+        if self._flip_streak >= cfg.sustain:
+            self._flip_streak = 0
+            # the LEAST-loaded eligible replica, not an idle one: a flip
+            # migrates the replica's queue and live rows as resume
+            # arrivals (the failover currency — token-identical), so
+            # requiring idleness would make the flip unreachable exactly
+            # when the pressure calls for it
+            cands = sorted(
+                (occupancy(rt._replicas[n]), n) for n in others
+                if rt._replicas[n].engine.kv_swap is tier
+                and now - self._flip_t.get(n, -1e9) >= cfg.flip_dwell_s)
+            if cands:
+                name = cands[0][1]
+                self._flipped.setdefault(name, rt._roles[name])
+                if driver.request_role_flip(name, "prefill"):
+                    self._flip_t[name] = now
+                    self._note(tick, "role_flip", name,
+                               f"-> prefill (prefill backlog "
+                               f"{per_prefill:.0f} tokens/replica)")
+                else:
+                    self._flipped.pop(name, None)
+            return                    # one action per evaluation
+        flipped_live = [n for n in self._flipped if n in live
+                        and rt._roles[n] == "prefill"
+                        and now - self._flip_t.get(n, -1e9) >=
+                        cfg.flip_dwell_s]
+        want_back = (flipped_live
+                     and all(rt._prefill_score(rt._replicas[n]) <=
+                             cfg.flip_back_low for n in flipped_live))
+        self._back_streak = self._back_streak + 1 if want_back else 0
+        if self._back_streak >= cfg.sustain:
+            self._back_streak = 0
+            name = sorted(flipped_live)[-1]
+            orig = self._flipped[name]
+            if driver.request_role_flip(name, orig):
+                self._flip_t[name] = now
+                self._flipped.pop(name, None)
+                self._note(tick, "role_flip", name,
+                           f"-> {orig} (prefill backlog drained)")

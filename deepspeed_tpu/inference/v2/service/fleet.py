@@ -1,0 +1,1006 @@
+"""Thread-per-replica fleet driver: true concurrency for the serving fleet.
+
+``EngineRouter``'s cooperative stepping loop is deterministic — the chaos
+suites depend on that — but it serializes every replica's frames on one
+host thread: while replica A's frame runs, B..N sit idle, so the fleet's
+wall-clock throughput is the SUM of its replicas' frame times instead of
+the max. This module is the concurrent twin (``RouterConfig(
+driver="threaded")``; ISSUE 14 / ROADMAP item 2):
+
+* **One worker thread per replica** drives that replica's
+  ``serve(..., yield_boundaries=True)`` generator. The compiled frame
+  releases the GIL while it executes, so replicas genuinely overlap; the
+  worker owns the generator exclusively (creation, stepping, snapshots,
+  close all happen on its thread — a generator is not shareable across
+  threads mid-execution).
+* **Mailboxes, not locks around the fleet**: arrivals flow router->worker
+  through a per-replica ``Mailbox`` (a deque with atomic append/drain and
+  a wake event — the only lock is per-mailbox and uncontended), and
+  boundary/completion/handoff events flow worker->router through one
+  ``queue.Queue``.
+* **The router thread** (the caller's thread under ``serve()``, a daemon
+  thread under ``start()``) consumes those events and runs EXACTLY the
+  serial loop's policy code — ``EngineRouter._place``/``_fail_replica``/
+  ``_handle_handoff``/rejoin/drain — against the router's own state, so
+  placement, failover, heartbeats, and the resume-arrival failover
+  currency are identical. Greedy outputs are token-identical to the
+  serial driver on the same schedule (timing differs; token identity is
+  timing-independent by the resume-arrival construction, and the bench's
+  routing-overhead row measures exactly what the overlap buys).
+* **Streaming**: every ``ServeBoundary`` now carries the frame's
+  ``emissions``; ``submit(item, subscriber=...)`` delivers them
+  per-request as they commit — the HTTP/SSE front-end (``edge.py``)
+  attaches here. Client disconnects cancel through the engine's existing
+  deadline/cancel path (``engine.cancel_request``).
+
+All router-policy state is touched ONLY on the router thread. Workers
+read their own engine exclusively; the one cross-thread engine call is
+``cancel_request`` (two field writes on an existing ledger entry,
+documented thread-safe).
+"""
+
+import collections
+import dataclasses
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional
+
+from ....utils.logging import logger
+from ..engine_v2 import HandoffEvent, ServeBoundary
+from ..faults import FrameDispatchError, snapshot_split
+from ..router import (CLOSED, DEAD, DRAINED, DRAINING, HEALTHY, QUARANTINED,
+                      RouterFault)
+
+
+class Mailbox(collections.deque):
+    """A replica's arrival feed, safe against the router thread appending
+    while the worker thread drains. Deque ops are GIL-atomic one at a
+    time; the lock makes multi-op sections (drain-all, snapshot
+    iteration) atomic too, and the wake event lets an idle worker block
+    instead of busy-polling. ``appended``/``drained`` are monotonic item
+    counts — the router thread compares them to decide whether the engine
+    has *seen* a placed arrival (the engine-retired reaping logic)."""
+
+    def __init__(self):
+        super().__init__()
+        self._lock = threading.RLock()
+        self.wake = threading.Event()
+        self.appended = 0
+        self.drained = 0
+
+    def append(self, item):
+        with self._lock:
+            super().append(item)
+            self.appended += 1
+            self.wake.set()
+
+    def drain_all(self) -> List:
+        with self._lock:
+            items = []
+            while True:
+                try:
+                    items.append(super().popleft())
+                except IndexError:
+                    break
+            self.drained += len(items)
+            self.wake.clear()
+            return items
+
+    def clear(self):
+        with self._lock:
+            self.drained += len(self)
+            super().clear()
+            self.wake.clear()
+
+    def popleft(self):
+        with self._lock:
+            item = super().popleft()
+            self.drained += 1
+            return item
+
+    def __iter__(self):
+        # snapshot iteration: router-side scoring/reaping iterates while
+        # the worker may drain concurrently
+        with self._lock:
+            return iter(list(super().__iter__()))
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Knobs for ``FleetDriver`` (router policy stays in RouterConfig)."""
+    # how long an IDLE worker blocks on its empty mailbox before letting
+    # the engine poll again (bounds both idle CPU burn and placement
+    # latency onto an idle replica); workers with live rows never wait
+    idle_wait_s: float = 0.005
+    # router-thread tick cadence when no events arrive (drives rejoin
+    # backoffs, deferred re-placements, and the autoscaler clock)
+    tick_interval_s: float = 0.005
+    # stop() deadline for worker threads to exit after their generators
+    # close (a hung jit cannot be interrupted; we warn and detach)
+    join_timeout_s: float = 30.0
+    # sliding window for the completed-tokens drain rate the edge's
+    # Retry-After derives from
+    rate_window_s: float = 5.0
+
+
+@dataclasses.dataclass
+class _BoundaryReport:
+    """Worker->router payload for one frame boundary, assembled while the
+    generator is suspended (everything here is a thread-local read)."""
+    boundary: ServeBoundary
+    step_t0: float                 # when the worker called next()
+    ledger_uids: frozenset         # engine ledger keys at this boundary
+    drained_through: int           # mailbox items the engine has polled
+    new_faults: List               # FaultReason entries from this boundary
+    new_sheds: List                # ShedReason entries from this boundary
+
+
+@dataclasses.dataclass
+class _Ended:
+    """Worker->router: the replica's serve generator is gone."""
+    reason: str                    # crash | kill | drain | role_flip |
+    #                                heartbeat | stop | closed
+    detail: str = ""
+    snapshot: Optional[Dict] = None
+
+
+class FleetDriver:
+    """Thread-per-replica driver over an ``EngineRouter`` (see module
+    docstring). The driver owns the router exclusively while running —
+    don't interleave ``router.serve()`` calls.
+
+    Two surfaces:
+
+    * ``serve(arrivals, **kw)`` — generator with the EXACT contract of
+      ``EngineRouter.serve`` (the router thread is the caller's thread);
+      what ``RouterConfig(driver="threaded")`` dispatches to.
+    * ``start(**kw)`` / ``submit(item, subscriber=)`` / ``cancel(uid)`` /
+      ``stop()`` — the long-lived service surface the HTTP edge uses:
+      the router thread runs as a daemon, arrivals come from any thread,
+      and per-request subscribers receive ``{"type": "tokens"|"done"|
+      "error", ...}`` events (called on the router thread — keep them
+      quick; the edge hands off to per-request queues).
+    """
+
+    def __init__(self, router, config: Optional[FleetConfig] = None,
+                 autoscaler=None):
+        self.router = router
+        self.cfg = config or FleetConfig()
+        self.autoscaler = autoscaler
+        self._events: queue.Queue = queue.Queue()
+        self._ingress: collections.deque = collections.deque()  # (item, sub)
+        self._ingress_lock = threading.Lock()
+        self._ingress_tokens = 0          # prompt tokens parked in ingress
+        # pressure gauges the edge reads cross-thread; the router thread
+        # refreshes them per tick (_refresh_pressure_cache)
+        self._queued_tokens_cache = 0
+        self._tps_cache = 0.0
+        self._best_score_cache: Optional[float] = None
+        self._cancels: collections.deque = collections.deque()
+        self._subs: Dict[int, Callable] = {}
+        self._streamed: Dict[int, int] = {}      # uid -> tokens delivered
+        self._threads: Dict[str, threading.Thread] = {}
+        self._reports: Dict[str, _BoundaryReport] = {}
+        self._pending_flips: Dict[str, str] = {}
+        self._place_seq: Dict[int, tuple] = {}   # uid -> (replica, seq)
+        self._completions: collections.deque = collections.deque()
+        self._rate_win: collections.deque = collections.deque()
+        self._serve_kwargs: Dict = {}
+        self._scheduler_factory = None
+        self._faults = None
+        self._arrivals = None
+        self._exhausted = True
+        self._stop_flag = False
+        self._started = False
+        self._thread: Optional[threading.Thread] = None
+        self._recovery_t0: Optional[float] = None
+        self._clock = time.monotonic
+        self.counters: Dict[str, int] = dict(
+            ticks=0, events=0, boundaries=0, cancels=0, submitted=0)
+
+    # ------------------------------------------------------------------
+    # public service surface
+    # ------------------------------------------------------------------
+
+    def start(self, *, max_new_tokens: int = 32, temperature: float = 0.0,
+              eos_token_id: Optional[int] = None, scheduler_factory=None,
+              faults=None, engine_kwargs: Optional[Dict] = None) -> None:
+        """Run the driver as a long-lived service: the router thread spins
+        as a daemon until ``stop()``; feed work with ``submit``."""
+        self._begin(max_new_tokens, temperature, eos_token_id,
+                    scheduler_factory, faults, engine_kwargs, arrivals=None)
+        self._thread = threading.Thread(target=self._service_loop,
+                                        name="ds-fleet-router", daemon=True)
+        self._thread.start()
+
+    def submit(self, item, subscriber: Optional[Callable] = None) -> int:
+        """Thread-safe request ingress (any thread). ``subscriber`` (if
+        given) receives streaming events for this uid on the router
+        thread. Returns the uid."""
+        uid = int(item["uid"] if isinstance(item, dict) else item[0])
+        with self._ingress_lock:
+            self._ingress.append((item, subscriber))
+            self._ingress_tokens += self._item_tokens(item)
+            # counter inside the lock: submit() runs concurrently from
+            # every edge handler thread and a bare += loses updates
+            self.counters["submitted"] += 1
+        return uid
+
+    def cancel(self, uid: int) -> None:
+        """Thread-safe cancellation (the edge's client-disconnect path):
+        the router thread routes it through ``engine.cancel_request`` —
+        the engine's next frame boundary frees the slot and KV blocks via
+        the existing deadline machinery."""
+        self._cancels.append(uid)
+
+    def stop(self) -> None:
+        """Shut the service down: workers close their generators (running
+        each engine's serve cleanup), the router thread exits."""
+        self._stop_flag = True
+        if self._thread is not None:
+            self._thread.join(timeout=self.cfg.join_timeout_s)
+            self._thread = None
+        self._shutdown_workers()
+        self._started = False
+
+    def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
+              temperature: float = 0.0, eos_token_id: Optional[int] = None,
+              scheduler_factory=None, faults=None,
+              engine_kwargs: Optional[Dict] = None):
+        """Generator with ``EngineRouter.serve``'s contract: yields
+        ``(uid, tokens)`` as requests finish on any replica, returns when
+        the arrival stream is exhausted and nothing is in flight. The
+        caller's thread is the router thread."""
+        self._begin(max_new_tokens, temperature, eos_token_id,
+                    scheduler_factory, faults, engine_kwargs,
+                    arrivals=iter(arrivals))
+        try:
+            while True:
+                self._run_tick()
+                while self._completions:
+                    yield self._completions.popleft()
+                if self._facade_done():
+                    break
+            # closing: let every generator drain to StopIteration, keep
+            # collecting any final completions
+            self._close_feeds()
+            while any(t.is_alive() for t in list(self._threads.values())):
+                self._run_tick(closing=True)
+                while self._completions:
+                    yield self._completions.popleft()
+            self._drain_events(block=False)
+            while self._completions:
+                yield self._completions.popleft()
+        finally:
+            self._stop_flag = True
+            self._shutdown_workers()
+            self._started = False
+
+    # ------------------------------------------------------------------
+    # pressure / introspection (edge admission reads these cross-thread;
+    # plain int/float reads, advisory by design)
+    # ------------------------------------------------------------------
+
+    def queued_tokens_estimate(self) -> int:
+        """Fleet-wide queued prompt tokens: engine-side queues (from each
+        replica's last boundary) + router-side feeds + everything parked
+        in deferred/unplaced/ingress. Handler threads read a CACHE the
+        router thread refreshes per tick — walking the router's deques from
+        another thread would both race their mutation (RuntimeError:
+        deque mutated during iteration, killing the handler) and make
+        every admission check O(backlog)."""
+        return self._queued_tokens_cache + self._ingress_tokens
+
+    def _refresh_pressure_cache(self) -> None:
+        """Router-thread-only: recompute the queued-token gauge and the
+        completed-token drain rate the edge reads cross-thread."""
+        rt = self.router
+        total = 0
+        for name, r in rt._replicas.items():
+            rep = self._reports.get(name)
+            if rep is not None and r.status in (HEALTHY, DRAINING):
+                total += rep.boundary.queued_tokens
+            total += rt._feed_prompt_tokens(r)
+        for _, item, _ in rt._deferred:
+            total += self._item_tokens(item)
+        for item, _ in rt._unplaced:
+            total += self._item_tokens(item)
+        self._queued_tokens_cache = total
+        scores = [rt._score(r) for r in rt._replicas.values()
+                  if r.accepting()]
+        self._best_score_cache = min(scores) if scores else None
+        now = self._clock()
+        win = self.cfg.rate_window_s
+        while self._rate_win and now - self._rate_win[0][0] > win:
+            self._rate_win.popleft()
+        toks = sum(n for _, n in self._rate_win)
+        span = max(now - self._rate_win[0][0], 1e-3) if self._rate_win \
+            else 1.0
+        self._tps_cache = toks / span if toks else 0.0
+
+    def best_placement_score(self) -> Optional[float]:
+        """The LEAST-loaded healthy replica's ``placement_score`` — the
+        edge's aggregate admission signal (if even the best destination
+        is past the shed threshold, the whole fleet is). None when no
+        replica accepts placements. Cached per tick: scoring walks
+        telemetry windows the worker threads mutate."""
+        return self._best_score_cache
+
+    def tokens_per_second(self) -> float:
+        """Completed-token drain rate over the sliding window (the
+        denominator of the edge's Retry-After) — cached per tick; the
+        ``_rate_win`` deque itself is router-thread-only."""
+        return self._tps_cache
+
+    def in_flight(self) -> int:
+        """Accepted-but-unfinished requests: assigned to a replica, OR
+        still in the submit() ingress queue the router thread has not
+        placed yet (without the ingress term, a caller polling right
+        after submit() would see a false idle)."""
+        return len(self.router._assignment) + len(self._ingress)
+
+    def stats(self) -> Dict:
+        out = self.router.stats()
+        out["driver"] = dict(self.counters)
+        out["driver"]["tokens_per_second"] = round(self.tokens_per_second(),
+                                                   2)
+        out["driver"]["queued_tokens"] = self.queued_tokens_estimate()
+        return out
+
+    # ------------------------------------------------------------------
+    # lifecycle internals
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _item_tokens(item) -> int:
+        if isinstance(item, dict):
+            return len(item["tokens"]) + len(item.get("generated") or ())
+        return len(item[1])
+
+    def _begin(self, max_new_tokens, temperature, eos_token_id,
+               scheduler_factory, faults, engine_kwargs, arrivals) -> None:
+        if self._started:
+            raise RuntimeError("FleetDriver is already running")
+        rt = self.router
+        self._serve_kwargs = dict(max_new_tokens=max_new_tokens,
+                                  temperature=temperature,
+                                  eos_token_id=eos_token_id,
+                                  **(engine_kwargs or {}))
+        self._scheduler_factory = scheduler_factory
+        self._faults = faults
+        self._arrivals = arrivals
+        self._exhausted = arrivals is None
+        self._stop_flag = False
+        self._started = True
+        self._tick = 0
+        self._recovery_t0 = None
+        rt._serve_limit = max_new_tokens
+        # fresh-run reset: same contract as the serial driver's serve()
+        # entry (stale routing state must not leak across runs; health
+        # survives, rejoin backoffs re-arm on the new tick clock)
+        rt._assignment.clear()
+        rt._affinity.clear()
+        rt._reroute_hops.clear()
+        rt._deferred = []
+        rt._unplaced.clear()
+        self._subs.clear()
+        self._streamed.clear()
+        self._place_seq.clear()
+        self._reports.clear()
+        self._pending_flips.clear()
+        self._completions.clear()
+        self._queued_tokens_cache = 0
+        self._tps_cache = 0.0
+        self._best_score_cache = None
+        self._events = queue.Queue()
+        for name, r in rt._replicas.items():
+            # swap the plain deque for a thread-safe mailbox (append-
+            # compatible: every router-side policy path keeps working)
+            mb = Mailbox()
+            for item in r.feed:
+                mb.append(item)
+            r.feed = mb
+            r.closing = False
+            r.gen = None          # workers own generators; the serial
+            #                       driver's handle must stay cleared
+            r.halt = threading.Event()
+            r.halt_reason = None
+            r.engine_idle = True
+            if r.status == CLOSED:
+                r.status = HEALTHY
+            if r.status == QUARANTINED and r.rejoin_tick is not None:
+                r.rejoin_tick = rt.cfg.quarantine_backoff_ticks * \
+                    (2 ** (r.failures - 1))
+        if faults is not None:
+            faults.begin()
+
+    def _service_loop(self) -> None:
+        while not self._stop_flag:
+            try:
+                self._run_tick()
+            except Exception as e:    # noqa: BLE001 — service must survive
+                logger.warning(f"FleetDriver: router tick raised "
+                               f"{type(e).__name__}: {e}")
+
+    def _facade_done(self) -> bool:
+        rt = self.router
+        return (self._exhausted and not self._ingress
+                and not rt._assignment and not rt._deferred
+                and not rt._unplaced
+                and not any(len(r.feed) for r in rt._replicas.values()))
+
+    def _close_feeds(self) -> None:
+        for r in self.router._replicas.values():
+            r.closing = True
+            r.feed.wake.set()
+
+    def _shutdown_workers(self) -> None:
+        for r in self.router._replicas.values():
+            r.closing = True
+            if getattr(r, "halt", None) is not None:
+                r.halt_reason = getattr(r, "halt_reason", None) or "stop"
+                r.halt.set()
+            if isinstance(r.feed, Mailbox):
+                r.feed.wake.set()
+        deadline = self._clock() + self.cfg.join_timeout_s
+        for name, t in list(self._threads.items()):
+            t.join(timeout=max(0.0, deadline - self._clock()))
+            if t.is_alive():
+                logger.warning(f"FleetDriver: worker {name} did not exit "
+                               f"within join_timeout_s; detaching")
+            else:
+                self._threads.pop(name, None)
+        self._drain_events(block=False)
+
+    # ------------------------------------------------------------------
+    # worker side (one thread per replica serve-generator incarnation)
+    # ------------------------------------------------------------------
+
+    def _spawn_workers(self) -> None:
+        for name, r in self.router._replicas.items():
+            if r.status not in (HEALTHY, DRAINING):
+                continue
+            t = self._threads.get(name)
+            if t is not None and t.is_alive():
+                continue
+            r.halt = threading.Event()
+            r.halt_reason = None
+            r.engine_idle = True
+            t = threading.Thread(target=self._worker, args=(r,),
+                                 name=f"ds-replica-{name}", daemon=True)
+            self._threads[name] = t
+            t.start()
+
+    def _feed_iter(self, r):
+        mb = r.feed
+        while True:
+            if (r.closing or r.halt.is_set()) and not mb:
+                return
+            batch = mb.drain_all()
+            if not batch and r.engine_idle and not r.closing \
+                    and not r.halt.is_set():
+                # idle replica: block briefly instead of spinning the
+                # engine's arrival poll (live replicas never wait here —
+                # their boundaries pace the polls)
+                mb.wake.wait(self.cfg.idle_wait_s)
+                batch = mb.drain_all()
+            yield batch
+
+    def _worker(self, r) -> None:
+        eng = r.engine
+        kwargs = dict(self._serve_kwargs)
+        if self._scheduler_factory is not None:
+            kwargs["scheduler"] = self._scheduler_factory()
+        sched = kwargs.get("scheduler")
+        ended = None
+        fault_seen = 0
+        shed_seen = 0
+        try:
+            gen = eng.serve(self._feed_iter(r), yield_boundaries=True,
+                            **kwargs)
+        except Exception as e:        # noqa: BLE001 — config error
+            self._events.put((r.name, _Ended("crash",
+                                             f"{type(e).__name__}: {e}")))
+            return
+        try:
+            while True:
+                if r.halt.is_set():
+                    # generator is suspended at a yield: the ledger is
+                    # consistent — snapshot BEFORE close clears it
+                    ended = _Ended(r.halt_reason or "stop",
+                                   snapshot=eng.snapshot_serving_state())
+                    return
+                t0 = self._clock()
+                try:
+                    item = next(gen)
+                except StopIteration:
+                    ended = _Ended("closed")
+                    return
+                except FrameDispatchError as e:
+                    ended = _Ended("crash", str(e),
+                                   snapshot=eng.last_crash_snapshot)
+                    return
+                except Exception as e:  # noqa: BLE001 — bad arrival etc.
+                    # unlike the serial driver (which lets this tear the
+                    # whole fleet serve down), a service quarantines the
+                    # replica and re-routes; the generator's finally
+                    # already ran its cleanup, so the ledger is empty —
+                    # only the unpolled feed survives as orphans
+                    ended = _Ended("crash", f"{type(e).__name__}: {e}")
+                    return
+                if isinstance(item, ServeBoundary):
+                    r.engine_idle = not item.dispatched
+                    # structured terminal records since the last boundary
+                    # (thread-local reads; the bounded deques only rotate
+                    # past maxlen under sustained fault storms, where
+                    # per-request notification precision stops mattering)
+                    faults_all = list(eng.fault_log)
+                    new_faults = faults_all[fault_seen:] \
+                        if fault_seen <= len(faults_all) else faults_all
+                    fault_seen = len(faults_all)
+                    new_sheds = []
+                    if sched is not None:
+                        sheds_all = list(sched.shed_log)
+                        new_sheds = sheds_all[shed_seen:] \
+                            if shed_seen <= len(sheds_all) else sheds_all
+                        shed_seen = len(sheds_all)
+                    self._events.put((r.name, _BoundaryReport(
+                        boundary=item, step_t0=t0,
+                        ledger_uids=frozenset(eng._ledger),
+                        drained_through=r.feed.drained,
+                        new_faults=new_faults, new_sheds=new_sheds)))
+                elif isinstance(item, HandoffEvent):
+                    self._events.put((r.name, item))
+                else:
+                    self._events.put((r.name, item))
+        finally:
+            try:
+                gen.close()
+            except Exception as e:    # noqa: BLE001 — cleanup best-effort
+                logger.warning(f"FleetDriver: closing {r.name} serve "
+                               f"generator raised {type(e).__name__}: {e}")
+            if ended is not None:
+                self._events.put((r.name, ended))
+
+    # ------------------------------------------------------------------
+    # router-thread side
+    # ------------------------------------------------------------------
+
+    def _run_tick(self, closing: bool = False) -> None:
+        rt = self.router
+        cfg = rt.cfg
+        self._tick += 1
+        tick = self._tick
+        rt._tick = tick
+        self.counters["ticks"] += 1
+        if self._faults is not None and not closing:
+            for name in self._faults.drains(tick):
+                rt.drain(name)
+            for name in self._faults.kills(tick):
+                self._request_kill(name)
+        rt._maybe_rejoin(tick)
+        for name in sorted(rt._pending_drains):
+            r = rt._replicas[name]
+            if r.status == HEALTHY:
+                r.status = DRAINING
+                r.engine.begin_drain()
+                rt.counters["drains"] += 1
+        rt._pending_drains = {
+            n for n in rt._pending_drains
+            if rt._replicas[n].status == QUARANTINED}
+        if not closing:
+            self._spawn_workers()
+        # ingress: facade arrivals (one poll per tick, serial-compatible)
+        # then submit()-side arrivals from any thread
+        if not self._exhausted:
+            try:
+                batch = next(self._arrivals)
+            except StopIteration:
+                self._exhausted = True
+                batch = None
+            for item in (batch or []):
+                self._place_new(item, None)
+        while self._ingress:
+            with self._ingress_lock:
+                item, sub = self._ingress.popleft()
+                self._ingress_tokens -= self._item_tokens(item)
+            self._place_new(item, sub)
+        for _ in range(len(self._cancels)):   # bounded: retried cancels
+            ent = self._cancels.popleft()     # re-append for the NEXT tick
+            uid, retries = ent if isinstance(ent, tuple) else (ent, 0)
+            self._apply_cancel(uid, retries)
+        # deferred failover re-placements + parked arrivals
+        due = [d for d in rt._deferred if d[0] <= tick]
+        rt._deferred = [d for d in rt._deferred if d[0] > tick]
+        for _, item, exclude in due:
+            rt._place(item, exclude)
+        for _ in range(len(rt._unplaced)):
+            item, exclude = rt._unplaced.popleft()
+            rt._place(item, exclude)
+        if self._recovery_t0 is not None and not rt._deferred \
+                and not rt._unplaced:
+            rt.last_recovery_ms = round(
+                (self._clock() - self._recovery_t0) * 1e3, 3)
+            self._recovery_t0 = None
+        # consume worker events (block briefly so the tick clock advances
+        # even when the fleet is idle)
+        self._drain_events(block=not closing)
+        self._refresh_place_seq()
+        self._reap_engine_retired()
+        self._refresh_pressure_cache()
+        if self.autoscaler is not None and not closing:
+            try:
+                self.autoscaler.on_tick(self, tick)
+            except Exception as e:    # noqa: BLE001 — advisory controller
+                logger.warning(f"FleetDriver: autoscaler raised "
+                               f"{type(e).__name__}: {e}")
+
+    def _drain_events(self, block: bool) -> None:
+        try:
+            name, payload = self._events.get(
+                timeout=self.cfg.tick_interval_s if block else 0.0)
+        except queue.Empty:
+            return
+        while True:
+            self.counters["events"] += 1
+            self._handle_event(name, payload)
+            try:
+                name, payload = self._events.get_nowait()
+            except queue.Empty:
+                return
+
+    def _handle_event(self, name: str, payload) -> None:
+        rt = self.router
+        r = rt._replicas[name]
+        tick = self._tick
+        if isinstance(payload, _BoundaryReport):
+            self.counters["boundaries"] += 1
+            b = payload.boundary
+            self._reports[name] = payload
+            self._stream_emissions(b)
+            self._notify_terminal(payload)
+            hb_fail = rt._note_heartbeat(r, b, tick, payload.step_t0)
+            if hb_fail is not None and r.status in (HEALTHY, DRAINING) \
+                    and not r.halt.is_set():
+                r.halt_reason = "heartbeat:" + hb_fail
+                r.halt.set()
+                r.feed.wake.set()
+            if r.status == DRAINING and b.live == 0 \
+                    and not r.halt.is_set():
+                r.halt_reason = "drain"
+                r.halt.set()
+                r.feed.wake.set()
+        elif isinstance(payload, HandoffEvent):
+            rt._handle_handoff(r, payload, tick)
+        elif isinstance(payload, _Ended):
+            self._handle_ended(r, payload, tick)
+        else:
+            uid, toks = payload
+            rt._finish(uid)
+            self._place_seq.pop(uid, None)
+            sub = self._subs.pop(uid, None)
+            if sub is not None:
+                streamed = self._streamed.pop(uid, 0)
+                tail = [int(t) for t in toks[streamed:]]
+                if tail:
+                    self._safe_sub(sub, {"type": "tokens", "uid": uid,
+                                         "tokens": tail})
+                self._safe_sub(sub, {"type": "done", "uid": uid,
+                                     "tokens": [int(t) for t in toks]})
+            else:
+                self._streamed.pop(uid, None)
+                self._completions.append((uid, toks))
+
+    def _handle_ended(self, r, ev: _Ended, tick: int) -> None:
+        rt = self.router
+        self._threads.pop(r.name, None)
+        self._reports.pop(r.name, None)
+        r.gen = None
+        reason = ev.reason.split(":", 1)[0]
+        if reason == "closed":
+            if r.status == HEALTHY:
+                r.status = CLOSED
+        elif reason == "stop":
+            if r.status in (HEALTHY, DRAINING):
+                r.status = CLOSED
+        elif reason == "drain":
+            snap = ev.snapshot or {"version": 1, "requests": []}
+            r.engine.end_drain()
+            r.status = DRAINED
+            exclude = frozenset((r.name,))
+            migrated = 0
+            for item in r.feed.drain_all():
+                rt._place(item, exclude)
+                migrated += 1
+            for item in rt._restamp_affinity(snapshot_split(snap)):
+                rt._place(item, exclude)
+                migrated += 1
+            rt.counters["drain_migrated"] += migrated
+            logger.warning(f"router: replica {r.name} drained at tick "
+                           f"{tick}; {migrated} queued requests migrated")
+        elif reason == "role_flip":
+            new_role = ev.reason.split(":", 1)[1]
+            self._pending_flips.pop(r.name, None)
+            snap = ev.snapshot or {"version": 1, "requests": []}
+            exclude = frozenset((r.name,))
+            for item in r.feed.drain_all():
+                rt._place(item, exclude)
+            for item in rt._restamp_affinity(snapshot_split(snap)):
+                rt._place(item, exclude)
+            try:
+                # validate BEFORE touching the engine: a half-applied
+                # flip (engine role changed, router table not) would make
+                # the router place decode work on a replica that hands
+                # everything straight back — a silent ping-pong livelock
+                rt.validate_replica_role(r.name, new_role)
+                r.engine.set_role(new_role)
+                rt.set_replica_role(r.name, new_role)
+                rt.counters["scale_role_flips"] += 1
+                rt.fault_log.append(RouterFault(
+                    kind="role_flip", tick=tick, engine=r.name,
+                    detail=f"role -> {new_role}"))
+            except Exception as e:    # noqa: BLE001 — keep the old role
+                logger.warning(f"FleetDriver: role flip of {r.name} to "
+                               f"{new_role} failed: {e}")
+            # worker respawns with the (possibly unchanged) role next tick
+        elif reason == "kill":
+            rt.counters["engine_kills"] += 1
+            self._recovery_t0 = self._clock()
+            rt._fail_replica(r, tick, "engine_kill",
+                             ev.reason.partition(":")[2] or
+                             "scripted engine_kill", ev.snapshot)
+        elif reason == "heartbeat":
+            rt._fail_replica(r, tick, "missed_heartbeat",
+                             ev.reason.partition(":")[2], ev.snapshot)
+        else:   # crash
+            rt._fail_replica(r, tick, "engine_crash", ev.detail,
+                             ev.snapshot)
+
+    def _request_kill(self, name: str) -> bool:
+        r = self.router._replicas.get(name)
+        if r is None or r.status not in (HEALTHY, DRAINING):
+            return False
+        t = self._threads.get(name)
+        if t is None or not t.is_alive():
+            return False
+        r.halt_reason = "kill:scripted engine_kill"
+        r.halt.set()
+        r.feed.wake.set()
+        return True
+
+    def request_role_flip(self, name: str, role: str) -> bool:
+        """Autoscaler surface: restart ``name``'s serve generator with a
+        new engine role (its queue migrates to peers exactly like a
+        drain, so nothing is lost and greedy outputs stay
+        token-identical). No-op unless the replica is HEALTHY."""
+        r = self.router._replicas.get(name)
+        if r is None or r.status != HEALTHY or r.halt.is_set():
+            return False
+        if role == "prefill":
+            # count REQUESTED-but-uncommitted prefill flips too: two
+            # flips racing through their halt windows must not drain the
+            # fleet of decode capacity between validations. DEAD replicas
+            # are not capacity — they never rejoin
+            eff_nonprefill = [
+                n for n, ro in self.router._roles.items()
+                if ro != "prefill" and n != name
+                and self.router._replicas[n].status != DEAD
+                and self._pending_flips.get(n) != "prefill"]
+            if not eff_nonprefill:
+                logger.warning(f"FleetDriver: role flip of {name} to "
+                               "prefill refused: would leave no decode "
+                               "capacity (pending flips included)")
+                return False
+        try:
+            # pre-validate so an illegal flip is refused BEFORE the
+            # worker is halted (a post-halt rejection still restarts the
+            # generator and churns the replica's queue for nothing)
+            self.router.validate_replica_role(name, role)
+        except (ValueError, KeyError) as e:
+            logger.warning(f"FleetDriver: role flip of {name} to {role} "
+                           f"refused: {e}")
+            return False
+        t = self._threads.get(name)
+        if t is None or not t.is_alive():
+            # no live generator: flip synchronously
+            try:
+                r.engine.set_role(role)
+                self.router.set_replica_role(name, role)
+                self.router.counters["scale_role_flips"] += 1
+                return True
+            except Exception as e:    # noqa: BLE001
+                logger.warning(f"FleetDriver: role flip of {name} failed: "
+                               f"{e}")
+                return False
+        self._pending_flips[name] = role
+        r.halt_reason = f"role_flip:{role}"
+        r.halt.set()
+        r.feed.wake.set()
+        return True
+
+    # ------------------------------------------------------------------
+    # placement / streaming / reaping helpers (router thread only)
+    # ------------------------------------------------------------------
+
+    def _place_new(self, item, subscriber) -> None:
+        uid = int(item["uid"] if isinstance(item, dict) else item[0])
+        if subscriber is not None:
+            self._subs[uid] = subscriber
+            self._streamed.setdefault(uid, 0)
+        placed = self.router._place(item)
+        if not placed and uid not in self.router._assignment:
+            # terminally unservable (no replica can ever hold it): the
+            # router already logged request_failed — tell the subscriber
+            parked = any(self._uid_of_parked(i) == uid
+                         for i, _ in self.router._unplaced)
+            parked = parked or any(
+                self._uid_of_parked(i) == uid
+                for _, i, _ in self.router._deferred)
+            if not parked:
+                sub = self._subs.pop(uid, None)
+                self._streamed.pop(uid, None)
+                if sub is not None:
+                    self._safe_sub(sub, {
+                        "type": "error", "uid": uid,
+                        "reason": "unservable",
+                        "detail": "prompt fits no live replica"})
+
+    @staticmethod
+    def _uid_of_parked(item) -> int:
+        return int(item["uid"] if isinstance(item, dict) else item[0])
+
+    def _apply_cancel(self, uid: int, retries: int = 0) -> None:
+        rt = self.router
+        if retries == 0:
+            self.counters["cancels"] += 1
+        # queued router-side? drop it before it ever reaches an engine
+        for coll in (rt._unplaced, ):
+            for entry in list(coll):
+                if self._uid_of_parked(entry[0]) == uid:
+                    coll.remove(entry)
+                    rt._finish(uid)
+                    rt.counters["completions"] -= 1   # not a completion
+                    self._notify_cancelled(uid)
+                    return
+        for entry in list(rt._deferred):
+            if self._uid_of_parked(entry[1]) == uid:
+                rt._deferred.remove(entry)
+                rt._finish(uid)
+                rt.counters["completions"] -= 1
+                self._notify_cancelled(uid)
+                return
+        name = rt._assignment.get(uid)
+        if name is None:
+            return
+        r = rt._replicas[name]
+        # still in the router->engine mailbox? yank it there
+        with r.feed._lock:
+            for item in list(r.feed):
+                if self._uid_of_parked(item) == uid:
+                    collections.deque.remove(r.feed, item)
+                    r.feed.drained += 1
+                    rt._finish(uid)
+                    rt.counters["completions"] -= 1
+                    self._notify_cancelled(uid)
+                    return
+        # the engine owns it: cancel through the deadline path (the
+        # boundary frees the slot + KV blocks; the reap below clears the
+        # assignment when the ledger drops it). A False return with the
+        # uid still assigned means the request is IN TRANSIT — a handoff
+        # event in the queue, or a drain/flip/failover snapshot awaiting
+        # re-placement — so retry at a later tick until it lands
+        # somewhere cancellable (bounded: the uid leaves _assignment at
+        # completion anyway, the budget just stops a pathological spin)
+        if not r.engine.cancel_request(uid) and uid in rt._assignment:
+            if retries < 1000:
+                self._cancels.append((uid, retries + 1))
+            else:
+                logger.warning(f"FleetDriver: cancel of uid={uid} gave up "
+                               "after 1000 retries (request in transit)")
+
+    def _notify_cancelled(self, uid: int) -> None:
+        sub = self._subs.pop(uid, None)
+        self._streamed.pop(uid, None)
+        self._place_seq.pop(uid, None)
+        # a router-side cancellation is as terminal as a failed request:
+        # any handoff pages the request published into the shared tier
+        # are orphaned now — only the router can release them (engines
+        # drop records only for requests they retire themselves)
+        self.router._drop_tier_record(uid)
+        if sub is not None:
+            self._safe_sub(sub, {"type": "error", "uid": uid,
+                                 "reason": "cancelled"})
+
+    def _stream_emissions(self, b: ServeBoundary) -> None:
+        if not b.emissions:
+            return
+        now = self._clock()
+        for uid, toks in b.emissions.items():
+            if not toks:
+                continue
+            self._rate_win.append((now, len(toks)))
+            sub = self._subs.get(uid)
+            if sub is None:
+                continue
+            self._streamed[uid] = self._streamed.get(uid, 0) + len(toks)
+            self._safe_sub(sub, {"type": "tokens", "uid": int(uid),
+                                 "tokens": [int(t) for t in toks]})
+
+    def _notify_terminal(self, rep: _BoundaryReport) -> None:
+        """Engine-side terminal retirements (cancel, deadline, shed,
+        quarantine) never yield — surface them to subscribers from the
+        boundary's structured fault/shed records."""
+        for f in rep.new_faults:
+            if f.uid is None or f.uid < 0:
+                continue
+            # TERMINAL kinds only — resume_truncated, for instance, is a
+            # warning on a request that keeps serving (clamped budget)
+            # and later completes normally
+            if f.kind in ("cancelled", "deadline_expired", "poison_row"):
+                sub = self._subs.pop(f.uid, None)
+                if sub is not None:
+                    self._streamed.pop(f.uid, None)
+                    self._safe_sub(sub, {"type": "error", "uid": f.uid,
+                                         "reason": f.kind,
+                                         "detail": f.detail,
+                                         "partial": f.partial or []})
+        for s in rep.new_sheds:
+            sub = self._subs.pop(s.uid, None)
+            if sub is not None:
+                self._streamed.pop(s.uid, None)
+                self._safe_sub(sub, {"type": "error", "uid": s.uid,
+                                     "reason": "shed:" + s.reason})
+
+    @staticmethod
+    def _safe_sub(sub, event) -> None:
+        try:
+            sub(event)
+        except Exception as e:        # noqa: BLE001 — a bad subscriber
+            logger.warning(f"FleetDriver: subscriber raised "
+                           f"{type(e).__name__}: {e}")
+
+    def _refresh_place_seq(self) -> None:
+        """Record, per assigned uid, the mailbox append-watermark at the
+        time we first see its assignment (conservative upper bound on its
+        own append seq) — the engine has definitely consumed the item
+        once the mailbox's drained count passes it."""
+        rt = self.router
+        for uid, name in rt._assignment.items():
+            rec = self._place_seq.get(uid)
+            if rec is None or rec[0] != name:
+                self._place_seq[uid] = (name, rt._replicas[name].feed.appended)
+
+    def _reap_engine_retired(self) -> None:
+        """The threaded twin of ``EngineRouter._reap_engine_retired``:
+        clear assignments for uids an engine retired WITHOUT yielding
+        (deadline/cancel/quarantine/shed). Uses each replica's last
+        boundary report (ledger snapshot + drain watermark) instead of
+        touching engine state cross-thread."""
+        rt = self.router
+        pending = {self._uid_of_parked(i) for _, i, _ in rt._deferred}
+        pending |= {self._uid_of_parked(i) for i, _ in rt._unplaced}
+        for uid, name in list(rt._assignment.items()):
+            r = rt._replicas[name]
+            if r.status in (QUARANTINED, DEAD) or uid in pending:
+                continue
+            rep = self._reports.get(name)
+            rec = self._place_seq.get(uid)
+            if rep is None or rec is None or rec[0] != name:
+                continue
+            if rep.drained_through < rec[1]:
+                continue              # engine may not have polled it yet
+            if uid in rep.ledger_uids:
+                continue              # alive in the engine
+            if any(self._uid_of_parked(i) == uid for i in r.feed):
+                continue              # re-placed after the report
+            rt._assignment.pop(uid, None)
+            rt._affinity.pop(uid, None)
+            rt._reroute_hops.pop(uid, None)
+            self._place_seq.pop(uid, None)
+            rt.counters["engine_retired"] += 1
+            sub = self._subs.pop(uid, None)
+            if sub is not None:
+                self._streamed.pop(uid, None)
+                self._safe_sub(sub, {"type": "error", "uid": uid,
+                                     "reason": "retired"})

@@ -250,7 +250,7 @@ class ServingTelemetry:
                              # faults (kind-labeled), plus the per-kind
                              # headline counters the SLO dashboard plots
                              faults=0, quarantined=0, deadline_expired=0,
-                             nonfinite_repaired=0,
+                             cancelled=0, nonfinite_repaired=0,
                              recoveries=0, frame_retries=0, slow_frames=0,
                              # KV memory hierarchy (kv_hierarchy.py):
                              # prefix-cache hit/publish/COW traffic and
@@ -273,7 +273,8 @@ class ServingTelemetry:
                              # records, and async swap-out commit modes
                              # (overlapped with the next frame vs forced
                              # blocking at a lookup)
-                             handoffs_out=0, tier_prefix_hits=0,
+                             handoffs_out=0, handoffs_pipelined=0,
+                             tier_prefix_hits=0,
                              tier_prefix_hit_tokens=0,
                              kv_swap_commits_overlapped=0,
                              kv_swap_commits_blocking=0)
@@ -496,6 +497,8 @@ class ServingTelemetry:
             self.counters["nonfinite_repaired"] += 1
         elif kind == "deadline_expired":
             self.counters["deadline_expired"] += 1
+        elif kind == "cancelled":
+            self.counters["cancelled"] += 1
         elif kind == "dispatch_retry":
             self.counters["frame_retries"] += 1
         elif kind == "slow_frame":
@@ -562,16 +565,20 @@ class ServingTelemetry:
         if resume:
             self.counters["kv_swap_resume_restores"] += 1
 
-    def on_handoff_out(self, uid: int) -> None:
+    def on_handoff_out(self, uid: int, pipelined: bool = False) -> None:
         """A prefill-role engine finished ``uid``'s prefill, published its
         pages to the shared tier, and handed the request to the router for
         decode placement. The span closes WITHOUT latency samples (the
         request is still in flight — its decode replica owns the rest of
         its lifecycle; the TTFT recorded at this engine's first emission
-        already stands)."""
+        already stands). ``pipelined`` marks a handoff whose final record
+        segment was published during the first-token frame (engine
+        ``handoff_pipeline``), so the handoff boundary did no page I/O."""
         if not self.enabled:
             return
         self.counters["handoffs_out"] += 1
+        if pipelined:
+            self.counters["handoffs_pipelined"] += 1
         self._open_spans.pop(uid, None)
 
     def on_tier_prefix_hit(self, hit_tokens: int, n_blocks: int) -> None:
@@ -597,13 +604,27 @@ class ServingTelemetry:
         """LIVE SLO signal: p90 (ms) over the recent sample windows — the
         input the scheduler's control loop reads each frame boundary (the
         cumulative histograms would let a good warm-up mask a bad now).
-        Mirrored into ``serve_view['slo']`` for observability."""
+        Mirrored into ``serve_view['slo']`` for observability.
+
+        Thread-tolerant by retry: the threaded fleet driver's router
+        thread scores replicas through here while each replica's worker
+        thread appends samples — a snapshot that races an append raises
+        RuntimeError ("deque mutated during iteration") and is simply
+        retaken; after a few collisions the stale answer (None) degrades
+        scoring gracefully instead of killing the caller."""
         out: Dict[str, Optional[float]] = {}
         for name in ("ttft", "queue_wait"):
             w = self._win[name]
+            vals = None
+            for _ in range(4):
+                try:
+                    vals = list(w)
+                    break
+                except RuntimeError:     # mutated mid-snapshot: retake
+                    continue
             out[f"{name}_p90_ms"] = round(
-                float(np.percentile(np.asarray(w), 90)) * 1e3, 3) if w \
-                else None
+                float(np.percentile(np.asarray(vals), 90)) * 1e3, 3) \
+                if vals else None
         self.serve_view["slo"] = out
         return out
 

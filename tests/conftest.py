@@ -35,7 +35,7 @@ SANITIZE = os.environ.get("GRAFT_SANITIZE", "0") == "1"
 SERVING_SUITES = ("test_frame_serving", "test_serving_telemetry",
                   "test_serving_scheduler", "test_serving_faults",
                   "test_serving_tp", "test_kv_hierarchy", "test_router",
-                  "test_disagg")
+                  "test_disagg", "test_service")
 
 #: fault-injection suites intentionally produce NaN logits (poison rows):
 #: jax_debug_nans would abort the machinery under test
@@ -197,6 +197,14 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "multichip: exercises a multi-device mesh (virtual on "
         "CPU); tier-1-safe, selectable with -m multichip")
+    # service-edge tests (tests/test_service.py) drive the thread-per-
+    # replica fleet driver and the HTTP/SSE front-end on loopback; they
+    # poll outcomes with generous deadlines (never assert on timing), so
+    # they are tier-1-safe and run in every PR
+    config.addinivalue_line(
+        "markers", "service: thread-per-replica fleet driver + HTTP/SSE "
+        "service-edge tests; included in tier-1, selectable with "
+        "-m service")
 
 
 @pytest.fixture(autouse=True)

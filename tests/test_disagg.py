@@ -564,3 +564,146 @@ def test_boundary_reports_queued_tokens(tiny_model_params):
             seen.append(item.queued_tokens)
     assert max(seen) > 0, "a saturated table must report queued tokens"
     assert seen[-1] == 0, "the drained run ends with an empty queue"
+
+
+# ---------------------------------------------------------------------------
+# handoff pipelining (ISSUE 14 satellite): the final record segment is
+# published DURING the first-token frame, not after it
+# ---------------------------------------------------------------------------
+
+
+def _traced_publishes(eng):
+    """Instrument an engine's segment publishes; returns the log list of
+    (watermark, blocks, had_handoff_meta) tuples."""
+    log = []
+    orig = eng._publish_segments
+
+    def traced(uid, seq, stream, w, nb, handoff=None):
+        log.append((w, nb, handoff is not None))
+        return orig(uid, seq, stream, w, nb, handoff=handoff)
+
+    eng._publish_segments = traced
+    return log
+
+
+def test_handoff_pipelined_no_page_io_at_handoff(tiny_model_params,
+                                                 tmp_path, greedy_base):
+    """With ``handoff_pipeline`` on (the default), the final segment —
+    handoff metadata included — is published at the boundary BEFORE the
+    first-token frame, and the handoff boundary itself does ZERO page
+    publishes; outputs stay token-identical to the monolith (the decode
+    side replays the sub-frame tail cold). With the flag off, the final
+    publish happens at the handoff watermark, as before."""
+    model, params = tiny_model_params
+    for pipe in (True, False):
+        engines, tier = _fleet(model, params, tmp_path / f"p{pipe}",
+                               prefill={"handoff_pipeline": pipe},
+                               decode={"handoff_pipeline": pipe})
+        pe = engines["prefill0"]
+        log = _traced_publishes(pe)
+        router = _router(engines)
+        outs = dict(router.serve(_mix_arrivals(), max_new_tokens=MAX_NEW))
+        _assert_parity(outs, greedy_base)
+        assert router.stats()["counters"]["handoffs"] == 2
+        plen = len(LONGS[0])
+        final_pubs = [e for e in log if e[2]]
+        assert len(final_pubs) == 2
+        if pipe:
+            # final (metadata-carrying) publish lands BELOW the prompt
+            # watermark — i.e. before the first-token frame completed it
+            assert all(w < plen for w, _, _ in final_pubs), final_pubs
+            assert pe.telemetry.counters["handoffs_pipelined"] == 2
+        else:
+            # legacy: the final publish covers the full prompt watermark
+            assert all(w >= plen for w, _, _ in final_pubs), final_pubs
+            assert pe.telemetry.counters["handoffs_pipelined"] == 0
+        for eng in engines.values():
+            _assert_clean(eng)
+
+
+def test_handoff_pipelined_segment_ordering(tiny_model_params, tmp_path):
+    """Segment-ordering invariant under pipelining: every record's
+    segments cover ``blocks_for(tokens)`` blocks contiguously (sum of
+    per-segment block counts == record blocks), including the
+    partial-tail case (frame_steps=1: the final publish's tail block is
+    mid-fill), and the record restores cleanly into a fresh engine."""
+    model, params = tiny_model_params
+    tier = KVSwapTier(str(tmp_path / "tier"), shared=True)
+    pe = _engine(model, params, role="prefill", frame_steps=1)
+    pe.attach_kv_tier(tier, tag="p")
+    records = {}
+    orig = KVSwapTier.stamp_request_handoff
+
+    def arrivals():
+        yield [{"uid": 0, "tokens": LONGS[0], "max_new_tokens": 4}]
+
+    ho = None
+    for ev in pe.serve(arrivals(), max_new_tokens=4,
+                       yield_boundaries=True):
+        if isinstance(ev, HandoffEvent):
+            ho = ev
+            # capture the record AT the handoff boundary, before the
+            # router-side lifecycle drops it
+            records[0] = tier.request_record(0)
+    assert ho is not None and ho.published
+    rec = records[0]
+    assert rec is not None
+    # chunk-aligned watermark at or below the prompt; tail replayed cold
+    assert rec["tokens"] % CHUNK == 0
+    assert rec["tokens"] <= len(LONGS[0])
+    assert rec["handoff"]["pipelined"] is True
+    # contiguous coverage: blocks == blocks_for(tokens) == sum(segments)
+    assert rec["blocks"] == pe.kv.blocks_for(rec["tokens"])
+    assert rec["blocks"] == sum(s["blocks"] for s in rec["segments"])
+    # the partial-tail block really is partial (frame_steps=1 with
+    # CHUNK < BS makes the final watermark straddle a block)
+    assert rec["tokens"] < rec["blocks"] * BS
+    # and the record restores into a fresh engine's pool
+    de = _engine(model, params)
+    de.attach_kv_tier(tier, tag="d")
+    blocks = de.kv.allocator.allocate(rec["blocks"])
+    tier.restore_request(0, de.kv, blocks)
+    de.kv.allocator.free(blocks)
+    assert orig is KVSwapTier.stamp_request_handoff
+
+
+def test_handoff_pipeline_heal_on_missed_prediction(tiny_model_params,
+                                                    tmp_path):
+    """A pipelined final publish whose handoff never came (the next
+    frame ran shorter than planned) must HEAL: the partial-tail record
+    is dropped and republished from block zero before any append, so
+    the ``blocks == blocks_for(tokens)`` restore invariant survives.
+    Forced directly: publish a partial final segment, then advance the
+    row as if more prefill happened and let the progress publish run."""
+    model, params = tiny_model_params
+    tier = KVSwapTier(str(tmp_path / "tier"), shared=True)
+    pe = _engine(model, params, role="prefill", frame_steps=1)
+    pe.attach_kv_tier(tier, tag="p")
+
+    class _Slots:                      # minimal slots view for the publish
+        def __init__(self, uid, cached, plen):
+            self.slot_of_uid = {uid: 0}
+            self.cached_h = [cached]
+            self.plen_h = [plen]
+
+    uid, plen = 0, 48
+    stream = [int(t) for t in LONGS[0][:plen]]
+    seq = pe.state.get_or_create_sequence(uid)
+    seq.blocks = pe.kv.allocator.allocate(pe.kv.blocks_for(plen))
+    pe._ledger_add(uid, stream, 4, 0.0, None, None)
+    pe._handoff_mode = True
+    # boundary A: watermark 40, remaining 8 <= chunk*steps -> pipelined
+    # partial publish (blocks_for(40)=3, block 2 partial)
+    pe._tier_publish_progress(_Slots(uid, 40, plen), 0, next_steps=1)
+    assert seq.tier_final and seq.tier_partial and seq.tier_blocks == 3
+    rec = tier.request_record(uid)
+    assert (rec["tokens"], rec["blocks"]) == (40, 3)
+    # prediction misses: the row is STILL mid-prefill at the next
+    # boundary with a higher watermark -> heal (drop + republish)
+    pe._tier_publish_progress(_Slots(uid, 40, plen + 48), 1, next_steps=1)
+    rec = tier.request_record(uid)
+    assert rec["blocks"] == pe.kv.blocks_for(rec["tokens"])
+    assert rec["blocks"] == sum(s["blocks"] for s in rec["segments"])
+    assert not seq.tier_partial
+    pe.state.flush_sequence(uid)
+    pe._ledger.clear()

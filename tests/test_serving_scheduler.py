@@ -628,3 +628,97 @@ def test_abandonment_releases_scheduler_state(served_engine):
     got = dict(e.serve(iter([[(110, PROMPTS[0])]]), max_new_tokens=4,
                        frame_slots=2))
     assert len(got[110]) == 4
+
+
+# ---------------------------------------------------------------------------
+# admission lookahead (ISSUE 14 satellite): slots reserved for
+# EWMA-predicted interactive arrivals
+# ---------------------------------------------------------------------------
+
+
+def test_lookahead_reserves_slots_for_predicted_interactive():
+    """Scripted schedule: one fresh interactive submission per boundary
+    establishes the EWMA; a batch burst then cannot fill the last
+    (reserved) slot, and the interactive arrival that lands one boundary
+    later admits immediately — no wait, no preemption."""
+    s = _sched(lookahead_reserve=True, lookahead_ewma_alpha=1.0,
+               lookahead_max_reserve=2)
+    # boundaries 1..3: one interactive arrival each -> ewma == 1.0
+    for b in range(3):
+        s.submit(_req(100 + b, prio=INTERACTIVE))
+        s.on_boundary({}, live_count=1)
+        picked = s.pick(4, lambda r: object(), live_count=1)
+        assert [r.uid for r, _ in picked] == [100 + b]
+    assert s._ia_ewma == 1.0
+    assert s.lookahead_reserved(4) == 1
+    # batch burst an instant before the predicted chat arrival: with 2
+    # free slots it may take only ONE (the other is reserved)
+    for u in range(4):
+        s.submit(_req(200 + u, prio=BATCH))
+    admitted = s.pick(2, lambda r: object(), live_count=2)
+    assert [r.uid for r, _ in admitted] == [200]
+    # ...and the predicted interactive arrival admits into the held slot
+    s.submit(_req(300, prio=INTERACTIVE))
+    s.on_boundary({}, live_count=3)
+    admitted = s.pick(1, lambda r: object(), live_count=3)
+    assert [r.uid for r, _ in admitted] == [300]
+
+
+def test_lookahead_off_burst_fills_every_slot():
+    """Control: without the reserve, the same burst takes both slots and
+    the chat arrival must wait for a retirement (or a preemption)."""
+    s = _sched()                      # lookahead_reserve defaults False
+    for b in range(3):
+        s.submit(_req(100 + b, prio=INTERACTIVE))
+        s.on_boundary({}, live_count=1)
+        s.pick(4, lambda r: object(), live_count=1)
+    for u in range(4):
+        s.submit(_req(200 + u, prio=BATCH))
+    admitted = s.pick(2, lambda r: object(), live_count=2)
+    assert [r.uid for r, _ in admitted] == [200, 201]
+    s.submit(_req(300, prio=INTERACTIVE))
+    s.on_boundary({}, live_count=4)
+    assert s.pick(0, lambda r: object(), live_count=4) == []
+    assert s.is_queued(300)
+
+
+def test_lookahead_reserve_decays_and_never_starves_batch():
+    """The reserve decays with the EWMA once interactive traffic stops,
+    and it never blocks the LAST admissible slot (a pure-batch workload
+    still makes progress at free_slots=1)."""
+    s = _sched(lookahead_reserve=True, lookahead_ewma_alpha=0.5,
+               lookahead_max_reserve=4)
+    for b in range(4):
+        s.submit(_req(100 + b, prio=INTERACTIVE))
+        s.on_boundary({}, live_count=1)
+        s.pick(8, lambda r: object(), live_count=1)
+    assert s.lookahead_reserved(8) >= 1
+    # free_slots=1: the reserve must never eat the last slot
+    assert s.lookahead_reserved(1) == 0
+    s.submit(_req(500, prio=BATCH))
+    assert [r.uid for r, _ in s.pick(1, lambda r: object(),
+                                     live_count=1)] == [500]
+    # interactive traffic stops: the EWMA (and the reserve) decay to zero
+    for _ in range(12):
+        s.on_boundary({}, live_count=1)
+    assert s.lookahead_reserved(8) == 0
+    # aged batch/BE work ignores the reserve (anti-starvation outranks
+    # lookahead, like deferral)
+    s2 = _sched(lookahead_reserve=True, lookahead_ewma_alpha=1.0,
+                aging_frames=1)
+    s2.submit(_req(0, prio=INTERACTIVE))
+    s2.on_boundary({}, live_count=1)
+    s2.pick(4, lambda r: object(), live_count=1)     # ewma == 1
+    s2.submit(_req(1, prio=BATCH))
+    s2.on_boundary({}, live_count=1)                 # ages 1 -> eff O(1)
+    s2.on_boundary({}, live_count=1)
+    admitted = s2.pick(1, lambda r: object(), live_count=1)
+    assert [r.uid for r, _ in admitted] == [1], \
+        "an aged-to-interactive request must ignore the reserve"
+
+
+def test_lookahead_config_validation():
+    with pytest.raises(ValueError, match="lookahead_ewma_alpha"):
+        SchedulerConfig(lookahead_ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="lookahead_max_reserve"):
+        SchedulerConfig(lookahead_max_reserve=-1)
