@@ -133,6 +133,40 @@ def sse_generate(host: str, port: int, body: Dict, timeout: float = 120.0):
 # closed-loop sessions
 # ----------------------------------------------------------------------
 
+def _aggregate(results: Dict, failures: List[str], sheds: Dict,
+               elapsed: float, **mode_fields) -> Dict:
+    """Shared report tail for ``run_load``/``run_open_loop`` — one
+    definition of the mismatch check, percentile summaries, and report
+    keys, so closed- and open-loop runs can never drift apart. Callers
+    pass SNAPSHOTS (taken under their lock — a straggler thread past the
+    join timeout may still be writing)."""
+    stream_mismatch = [
+        k for k, v in results.items()
+        if v["done"] is None or v["streamed"] != v["done"]]
+    ttfts = sorted(v["ttft_s"] for v in results.values())
+    e2es = sorted(v["e2e_s"] for v in results.values())
+    toks = sum(len(v["done"] or ()) for v in results.values())
+
+    def pct(xs, p):
+        return round(float(np.percentile(xs, p)) * 1e3, 2) if xs else None
+
+    return {
+        **mode_fields,
+        "completed": len(results),
+        "failures": failures[:20], "n_failures": len(failures),
+        "edge_sheds_seen": sheds["count"],
+        "retry_wait_s": round(sheds["retry_wait_s"], 2),
+        "stream_vs_done_mismatches": len(stream_mismatch),
+        "elapsed_s": round(elapsed, 3),
+        "tokens": toks,
+        "tok_per_sec": round(toks / max(elapsed, 1e-9), 1),
+        "ttft_ms": {"p50": pct(ttfts, 50), "p90": pct(ttfts, 90),
+                    "p99": pct(ttfts, 99)},
+        "e2e_ms": {"p50": pct(e2es, 50), "p90": pct(e2es, 90)},
+        "_results": results,       # stripped before JSON dump
+    }
+
+
 def run_load(host: str, port: int, sched: Dict, sessions: int, turns: int,
              max_shed_retries: int = 20) -> Dict:
     """Drive the schedule with one thread per session; returns the
@@ -176,32 +210,67 @@ def run_load(host: str, port: int, sched: Dict, sessions: int, turns: int,
     for th in threads:
         th.join(timeout=600)
     elapsed = time.monotonic() - t0
+    with lock:
+        snap, fails, shed_snap = dict(results), list(failures), dict(sheds)
+    return _aggregate(snap, fails, shed_snap, elapsed,
+                      sessions=sessions, turns=turns,
+                      requests=sessions * turns)
 
-    stream_mismatch = [
-        k for k, v in results.items()
-        if v["done"] is None or v["streamed"] != v["done"]]
-    ttfts = sorted(v["ttft_s"] for v in results.values())
-    e2es = sorted(v["e2e_s"] for v in results.values())
-    toks = sum(len(v["done"] or ()) for v in results.values())
 
-    def pct(xs, p):
-        return round(float(np.percentile(xs, p)) * 1e3, 2) if xs else None
+# ----------------------------------------------------------------------
+# open-loop (arrival-rate) sessions — the PR-12 ROADMAP follow-up
+# ----------------------------------------------------------------------
 
-    return {
-        "sessions": sessions, "turns": turns,
-        "requests": sessions * turns, "completed": len(results),
-        "failures": failures[:20], "n_failures": len(failures),
-        "edge_sheds_seen": sheds["count"],
-        "retry_wait_s": round(sheds["retry_wait_s"], 2),
-        "stream_vs_done_mismatches": len(stream_mismatch),
-        "elapsed_s": round(elapsed, 3),
-        "tokens": toks,
-        "tok_per_sec": round(toks / max(elapsed, 1e-9), 1),
-        "ttft_ms": {"p50": pct(ttfts, 50), "p90": pct(ttfts, 90),
-                    "p99": pct(ttfts, 99)},
-        "e2e_ms": {"p50": pct(e2es, 50), "p90": pct(e2es, 90)},
-        "_results": results,       # stripped before JSON dump
-    }
+def run_open_loop(host: str, port: int, sched: Dict, rate: float) -> Dict:
+    """OPEN-loop load: requests fire at a fixed arrival RATE on their own
+    threads — nobody waits for a previous completion, so offered load
+    does NOT self-regulate and overload actually lands on the edge
+    (closed-loop sessions slow down with the system and can never
+    overdrive it). Each scheduled request (session, turn) launches at a
+    deterministic offset ``i / rate`` seconds; an edge shed (429) is
+    counted and DROPPED — in an open-loop world the arrival is lost, not
+    retried, which is exactly the regime tracing overhead must be
+    measured under. Returns the same report shape as ``run_load`` (shed
+    requests are not failures; ``completed + edge_sheds_seen`` accounts
+    for every arrival)."""
+    order = sorted(sched)
+    results: Dict[Tuple[int, int], Dict] = {}
+    lock = threading.Lock()
+    failures: List[str] = []
+    sheds = {"count": 0, "retry_wait_s": 0.0}
+    start = time.monotonic() + 0.05        # common launch epoch
+
+    def fire(i: int, key) -> None:
+        req = sched[key]
+        delay = start + i / max(rate, 1e-6) - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)
+        body = {k: req[k] for k in ("prompt", "max_new_tokens",
+                                    "tenant", "priority")}
+        body["session"] = f"s{key[0]}"
+        status, out = sse_generate(host, port, body)
+        with lock:
+            if status == 200:
+                results[key] = out
+            elif status == 429:
+                sheds["count"] += 1
+                sheds["retry_wait_s"] += out
+            else:
+                failures.append(f"{key}: status={status} {out}")
+
+    threads = [threading.Thread(target=fire, args=(i, key), daemon=True)
+               for i, key in enumerate(order)]
+    t0 = time.monotonic()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=600)
+    elapsed = time.monotonic() - t0
+    with lock:
+        snap, fails, shed_snap = dict(results), list(failures), dict(sheds)
+    return _aggregate(snap, fails, shed_snap, elapsed,
+                      mode="open-loop", arrival_rate_per_s=rate,
+                      requests=len(order))
 
 
 # ----------------------------------------------------------------------
@@ -299,6 +368,13 @@ def main():
     ap.add_argument("--max-new", type=int, default=8)
     ap.add_argument("--think-ms", type=float, default=200.0)
     ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--open-loop", action="store_true",
+                    help="arrival-RATE mode: requests fire at --rate/s "
+                         "regardless of completions (offered load does "
+                         "not self-regulate; 429s are dropped, not "
+                         "retried)")
+    ap.add_argument("--rate", type=float, default=20.0,
+                    help="open-loop arrival rate, requests/s (default 20)")
     ap.add_argument("--scheduler", action="store_true",
                     help="self-host with the SLO-aware RequestScheduler "
                          "(+ admission lookahead) per replica")
@@ -322,7 +398,10 @@ def main():
         host, port = "127.0.0.1", edge.edge_port
         ref = direct_reference(mk_engine, sched)
 
-    report = run_load(host, port, sched, args.sessions, args.turns)
+    if args.open_loop:
+        report = run_open_loop(host, port, sched, args.rate)
+    else:
+        report = run_load(host, port, sched, args.sessions, args.turns)
     if ref is not None:
         report["parity_violations"] = check_parity(report, ref)
     report.pop("_results")
@@ -335,7 +414,12 @@ def main():
     if args.out:
         with open(args.out, "w") as f:
             json.dump(report, f, indent=1)
-    ok = (report["completed"] == report["requests"]
+    # open-loop: a shed arrival is lost by design, not a failure — every
+    # arrival must still be ACCOUNTED for (completed or shed)
+    accounted = report["completed"] + (report["edge_sheds_seen"]
+                                       if args.open_loop else 0)
+    ok = (accounted == report["requests"]
+          and report["n_failures"] == 0
           and report["stream_vs_done_mismatches"] == 0
           and report.get("parity_violations", 0) == 0)
     sys.exit(0 if ok else 1)

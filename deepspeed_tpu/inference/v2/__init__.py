@@ -13,3 +13,5 @@ from .router import EngineRouter, RouterConfig  # noqa: F401
 from .scheduler import (RequestScheduler, SchedulerConfig,  # noqa: F401
                         ShedReason)
 from .telemetry import LogBucketHistogram, ServingTelemetry  # noqa: F401
+from .tracing import (FlightRecorder, TraceCollector,  # noqa: F401
+                      validate_trace)

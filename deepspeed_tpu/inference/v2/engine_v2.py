@@ -836,7 +836,12 @@ class InferenceEngineV2:
     @staticmethod
     def _norm_arrival(item, max_new_tokens, temperature, eos_token_id):
         """Normalize one arrival to ``(uid, tokens, limit, temp, eos,
-        tenant, priority, slo_ms, deadline_ms, generated)``.
+        tenant, priority, slo_ms, deadline_ms, generated, trace)``.
+
+        ``trace`` (dict arrivals only) is the distributed-trace context
+        ``{"id", "parent"}`` minted at the edge/router (``tracing.py``);
+        it rides the ledger so snapshots, failovers, and handoffs
+        continue the SAME trace on the next replica.
 
         ``generated`` (dict arrivals only; normally None) marks a RESUME
         arrival — the router's cross-engine failover/migration surface
@@ -877,6 +882,7 @@ class InferenceEngineV2:
             tenant, prio = item.get("tenant"), item.get("priority")
             slo_ms = item.get("slo_ms")
             deadline_ms = item.get("deadline_ms")
+            trace = item.get("trace")
             if deadline_ms is not None and deadline_ms <= 0:
                 raise ValueError(f"uid={uid}: deadline_ms must be > 0")
             generated = item.get("generated")
@@ -895,9 +901,10 @@ class InferenceEngineV2:
                 else temperature
             eos = item[4] if len(item) > 4 and item[4] is not None \
                 else eos_token_id
-            tenant = prio = slo_ms = deadline_ms = generated = None
+            tenant = prio = slo_ms = deadline_ms = generated = trace = None
         return uid, np.asarray(toks, np.int32).reshape(-1), int(limit), \
-            float(temp), eos, tenant, prio, slo_ms, deadline_ms, generated
+            float(temp), eos, tenant, prio, slo_ms, deadline_ms, generated, \
+            trace
 
     def serve(self, arrivals: Iterable, *, max_new_tokens: int = 32,
               temperature: float = 0.0, eos_token_id: Optional[int] = None,
@@ -1212,14 +1219,24 @@ class InferenceEngineV2:
 
     def _ledger_add(self, uid, toks, limit, temp, eos, deadline_ms,
                     tenant=None, priority=None, slo_ms=None,
-                    resumed_from=0) -> None:
+                    resumed_from=0, trace=None) -> None:
         self._ledger[uid] = LedgerEntry(
             uid=uid, prompt=[int(t) for t in toks], limit=int(limit),
             temp=float(temp), eos=eos,
             deadline_at=(None if deadline_ms is None
                          else self._clock() + deadline_ms * 1e-3),
             tenant=tenant, priority=priority, slo_ms=slo_ms,
-            resumed_from=resumed_from)
+            resumed_from=resumed_from, trace=trace)
+
+    def _enqueue_traced(self, uid, **kw) -> None:
+        """``telemetry.on_enqueue`` + write the effective trace context
+        back into the ledger entry: a trace minted BY the engine (tuple
+        arrivals carry none) must still ride snapshots, failovers, and
+        handoffs, or the continuation would start a second tree."""
+        trace = self.telemetry.on_enqueue(uid, **kw)
+        ent = self._ledger.get(uid)
+        if ent is not None and trace is not None:
+            ent.trace = trace
 
     def _ingest_resume(self, uid, toks, limit, gen, tel):
         """Shared core of mid-run RESUME-arrival ingestion (router
@@ -1263,7 +1280,8 @@ class InferenceEngineV2:
             out.append((uid, np.asarray(r["prompt"], np.int32),
                         int(r["limit"]), float(r["temp"]), r["eos"],
                         r.get("deadline_remaining_ms"), generated,
-                        r.get("tenant"), r.get("priority"), r.get("slo_ms")))
+                        r.get("tenant"), r.get("priority"), r.get("slo_ms"),
+                        r.get("trace")))
         return out
 
     def _fault_retire(self, uid: int, kind: str, frame: int, detail: str,
@@ -1563,7 +1581,8 @@ class InferenceEngineV2:
                                // chunk * chunk)
                     seq.resume_cached = cached0
                     self.telemetry.on_kv_swap_in(
-                        rec["blocks"], resume=uid in self._resume_pending)
+                        rec["blocks"], resume=uid in self._resume_pending,
+                        uid=uid)
                     return cached0
         # --- (2) prefix hit: the LOCAL cache first (device blocks shared
         # read-only — zero pool cost), then the SHARED tier's content-
@@ -1911,7 +1930,8 @@ class InferenceEngineV2:
                 seq.tier_final = final
                 seq.tier_partial = final and w < nb * bs
                 if n_new:
-                    self.telemetry.on_kv_swap_out(n_new)
+                    self.telemetry.on_kv_swap_out(n_new, uid=uid,
+                                                  publish=True)
             except Exception as e:   # noqa: BLE001 — publish is best-effort
                 self._fault_event(
                     "swap_failed", boundary,
@@ -1932,7 +1952,7 @@ class InferenceEngineV2:
             "eos_token_id": -1 if ent.eos is None else int(ent.eos),
         }
         for k, v in (("tenant", ent.tenant), ("priority", ent.priority),
-                     ("slo_ms", ent.slo_ms)):
+                     ("slo_ms", ent.slo_ms), ("trace", ent.trace)):
             if v is not None:
                 item[k] = v
         if ent.deadline_at is not None:
@@ -1995,7 +2015,8 @@ class InferenceEngineV2:
                                  "role": "prefill"})
                     published = True
                     if n_new:
-                        self.telemetry.on_kv_swap_out(n_new)
+                        self.telemetry.on_kv_swap_out(n_new, uid=uid,
+                                                      publish=True)
                 except Exception as e:   # noqa: BLE001 — decode re-prefills
                     self._fault_event(
                         "swap_failed", boundary,
@@ -2053,13 +2074,13 @@ class InferenceEngineV2:
         # (the preemption fold) so greedy outputs are token-identical
         # across the restart ----
         for (uid, prompt, limit, temp, eos, dl_ms, generated, _ten, _pri,
-             _slo) in resume:
+             _slo, trace) in resume:
             seq = self.state.get_or_create_sequence(uid)
             seq.generated = list(generated)
             seq.done = False
             self._ledger_add(uid, prompt, limit, temp, eos, dl_ms,
-                             resumed_from=len(generated))
-            tel.on_enqueue(uid, resumed=len(generated) > 0)
+                             resumed_from=len(generated), trace=trace)
+            self._enqueue_traced(uid, resumed=len(generated) > 0, trace=trace)
             remaining = limit - len(generated)
             if remaining <= 0:
                 # finished before the crashed run could yield it
@@ -2093,8 +2114,8 @@ class InferenceEngineV2:
                 # already reserved for earlier items in the same batch
                 for item in (batch or []):
                     (uid, toks, limit, temp, eos, _ten, _pri, _slo, dl_ms,
-                     gen) = self._norm_arrival(item, max_new_tokens,
-                                               temperature, eos_token_id)
+                     gen, trace) = self._norm_arrival(
+                         item, max_new_tokens, temperature, eos_token_id)
                     want = limit
                     limit = self._validate_arrival(
                         uid, toks, limit,
@@ -2109,8 +2130,10 @@ class InferenceEngineV2:
                         # crash-recovery ingestion, fed through the
                         # arrival stream; ledger keeps the originals
                         self._ledger_add(uid, toks, limit, temp, eos,
-                                         dl_ms, resumed_from=len(gen))
-                        tel.on_enqueue(uid, resumed=len(gen) > 0)
+                                         dl_ms, resumed_from=len(gen),
+                                         trace=trace)
+                        self._enqueue_traced(uid, resumed=len(gen) > 0,
+                                            trace=trace)
                         fold, done_out = self._ingest_resume(
                             uid, toks, limit, gen, tel)
                         if done_out is not None:
@@ -2120,8 +2143,9 @@ class InferenceEngineV2:
                         pending.append((uid, folded, remaining, temp, eos))
                         continue
                     pending.append((uid, toks, limit, temp, eos))
-                    self._ledger_add(uid, toks, limit, temp, eos, dl_ms)
-                    tel.on_enqueue(uid)
+                    self._ledger_add(uid, toks, limit, temp, eos, dl_ms,
+                                     trace=trace)
+                    self._enqueue_traced(uid, trace=trace)
             # ---- deadlines: expired work (queued or live) is cancelled
             # BEFORE admission can spend a slot or blocks on it ----
             self._expire_deadlines(slots, boundary, pending=pending)
@@ -2294,7 +2318,7 @@ class InferenceEngineV2:
                         draft_kv=self.draft_kv,
                         fingerprint=token_fingerprint(req.tokens[:w]),
                         async_commit=self._config.kv_swap_async)
-                    self.telemetry.on_kv_swap_out(n)
+                    self.telemetry.on_kv_swap_out(n, uid=uid)
                 except Exception as e:   # noqa: BLE001 — re-prefill instead
                     self._fault_event(
                         "swap_failed", boundary,
@@ -2344,7 +2368,7 @@ class InferenceEngineV2:
         # requests re-enter through the scheduler with their original
         # class/tenant/slo, tokens folded for re-prefill ----
         for (uid, prompt, limit, temp, eos, dl_ms, generated, tenant, prio,
-             slo_ms) in resume:
+             slo_ms, trace) in resume:
             seq = self.state.get_or_create_sequence(uid)
             seq.generated = list(generated)
             seq.done = False
@@ -2352,9 +2376,11 @@ class InferenceEngineV2:
             tenant = tenant or "default"
             self._ledger_add(uid, prompt, limit, temp, eos, dl_ms,
                              tenant=tenant, priority=PRIORITY_NAMES[prio],
-                             slo_ms=slo_ms, resumed_from=len(generated))
-            tel.on_enqueue(uid, tenant=tenant, pclass=PRIORITY_NAMES[prio],
-                           resumed=len(generated) > 0)
+                             slo_ms=slo_ms, resumed_from=len(generated),
+                             trace=trace)
+            self._enqueue_traced(uid, tenant=tenant,
+                                 pclass=PRIORITY_NAMES[prio],
+                                 resumed=len(generated) > 0, trace=trace)
             remaining = limit - len(generated)
             if remaining <= 0:
                 out = np.asarray(seq.generated, np.int64)
@@ -2395,7 +2421,7 @@ class InferenceEngineV2:
                 ewma = alpha * len(batch or []) + (1.0 - alpha) * ewma
                 for item in (batch or []):
                     uid, toks, limit, temp, eos, tenant, prio, slo_ms, \
-                        dl_ms, gen = self._norm_arrival(
+                        dl_ms, gen, trace = self._norm_arrival(
                             item, max_new_tokens, temperature, eos_token_id)
                     want = limit
                     limit = self._validate_arrival(
@@ -2411,10 +2437,11 @@ class InferenceEngineV2:
                                      tenant=tenant,
                                      priority=PRIORITY_NAMES[prio],
                                      slo_ms=slo_ms,
-                                     resumed_from=len(gen) if gen else 0)
-                    tel.on_enqueue(uid, tenant=tenant,
-                                   pclass=PRIORITY_NAMES[prio],
-                                   resumed=bool(gen))
+                                     resumed_from=len(gen) if gen else 0,
+                                     trace=trace)
+                    self._enqueue_traced(uid, tenant=tenant,
+                                        pclass=PRIORITY_NAMES[prio],
+                                        resumed=bool(gen), trace=trace)
                     if gen is not None:
                         # mid-run RESUME arrival (router failover / drain
                         # migration / handoff): the submit bypasses the tenant

@@ -134,6 +134,10 @@ class LedgerEntry:
     # disconnect path): rides the deadline machinery but retires with a
     # ``cancelled`` FaultReason, not ``deadline_expired``
     cancelled: bool = False
+    # distributed-trace context (tracing.py): ``{"id", "parent"}`` minted
+    # at the edge/router/engine. Ledgered so snapshots carry it — a
+    # failover/handoff/drain resume continues the SAME trace on the peer
+    trace: Optional[Dict] = None
 
 
 @dataclasses.dataclass
@@ -333,7 +337,7 @@ def snapshot_split(snapshot: Dict) -> List[Dict]:
             "temperature": float(r["temp"]),
             "eos_token_id": -1 if r["eos"] is None else int(r["eos"]),
         }
-        for k in ("tenant", "priority", "slo_ms"):
+        for k in ("tenant", "priority", "slo_ms", "trace"):
             if r.get(k) is not None:
                 item[k] = r[k]
         if r.get("deadline_remaining_ms") is not None:
@@ -384,5 +388,6 @@ def snapshot_ledger(ledger: Dict[int, LedgerEntry], seqs: Dict,
             "tenant": ent.tenant,
             "priority": ent.priority,
             "slo_ms": ent.slo_ms,
+            "trace": ent.trace,
         })
     return {"version": 1, "requests": reqs}

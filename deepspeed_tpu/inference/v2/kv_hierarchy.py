@@ -491,6 +491,10 @@ class KVSwapTier:
                           blocks_in=0, commits_overlapped=0,
                           commits_blocking=0, commit_failures=0,
                           prefix_records=0, prefix_hits=0)
+        # crash flight recorder (tracing.FlightRecorder), wired by the
+        # router's attach_tracing: tier commits land in the fleet event
+        # ring so a postmortem shows the page traffic before a death
+        self.flight = None
         # async-committed records not yet in the index: (section, key, rec)
         self._pending: List[Tuple[str, str, Dict]] = []
         self._prefix_clock = max(
@@ -531,12 +535,18 @@ class KVSwapTier:
             self.swapper.wait()
         except Exception:
             self.stats["commit_failures"] += len(pend)
+            if self.flight is not None:
+                self.flight.record("tier_commit_failed", detail=f"{len(pend)} "
+                                   "queued records dropped")
             raise
         for section, key, rec in pend:
             self._index[section][key] = rec
         self._save_index()
         self.stats["commits_blocking" if blocking
                    else "commits_overlapped"] += len(pend)
+        if self.flight is not None:
+            self.flight.record("tier_commit", n=len(pend),
+                               mode="blocking" if blocking else "overlapped")
         return len(pend)
 
     def _drain_for_read(self) -> None:

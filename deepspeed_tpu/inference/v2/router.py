@@ -321,11 +321,53 @@ class EngineRouter:
             scale_up=0, scale_down=0, scale_role_flips=0)
         self._serve_limit = 32       # serve()'s max_new_tokens default
         #                              (the classification denominator)
+        # fleet-wide observability (tracing.py; attach_tracing): the
+        # distributed-trace collector every replica's telemetry feeds,
+        # and the crash flight recorder. Both None by default — zero new
+        # work on the placement/failover paths until attached.
+        self.tracer = None
+        self.flight = None
         self.placements_by_engine: Dict[str, int] = {
             name: 0 for name in self._replicas}
         self.last_recovery_ms: float = 0.0
         self._tick = 0               # current serve-loop tick (fault_log)
         self._clock = time.monotonic
+
+    # ------------------------------------------------------------------
+    # fleet-wide observability (tracing.py)
+    # ------------------------------------------------------------------
+
+    def attach_tracing(self, collector=None, recorder=None):
+        """Wire distributed tracing + the crash flight recorder through
+        the fleet (README "Distributed tracing & flight recorder"):
+        every replica's telemetry stamps boundary spans into ONE shared
+        ``TraceCollector`` (labeled with its replica name), the router
+        mints a trace at ingestion for arrivals the edge didn't stamp,
+        and fleet events (placements, failovers, heartbeat misses,
+        handoffs, kills, drains, tier commits) land in the
+        ``FlightRecorder`` ring — which dumps a postmortem bundle on
+        replica death or an engine crash snapshot. Defaults are built
+        when not passed; returns ``(collector, recorder)``."""
+        from .tracing import FlightRecorder, TraceCollector
+        self.tracer = collector if collector is not None else \
+            TraceCollector()
+        self.flight = recorder if recorder is not None else \
+            FlightRecorder(collector=self.tracer)
+        if self.flight.collector is None:
+            self.flight.collector = self.tracer
+        for name, r in self._replicas.items():
+            r.engine.telemetry.set_tracer(self.tracer, replica=name)
+        if self._tier is not None:
+            self._tier.flight = self.flight
+        return self.tracer, self.flight
+
+    @staticmethod
+    def _trace_of(item) -> Optional[Dict]:
+        return item.get("trace") if isinstance(item, dict) else None
+
+    def _flight_note(self, kind: str, **kw) -> None:
+        if self.flight is not None:
+            self.flight.record(kind, **kw)
 
     # ------------------------------------------------------------------
     # introspection
@@ -409,6 +451,13 @@ class EngineRouter:
         for t in order:
             lines.append(t)
             lines.extend(fams[t])
+        # fleet-level tracing + flight-recorder series (unique families —
+        # no per-replica merge needed): the fleet-merged ds_fleet_ttft_ms
+        # / ds_fleet_e2e_ms true-attribution summaries live here
+        if self.tracer is not None:
+            lines.extend(self.tracer.render_prometheus().splitlines())
+        if self.flight is not None:
+            lines.extend(self.flight.render_prometheus().splitlines())
         return "\n".join(lines) + "\n"
 
     # ------------------------------------------------------------------
@@ -565,6 +614,15 @@ class EngineRouter:
         uid = self._uid_of(item)
         key = self._affinity_key(item)
         self._affinity.setdefault(uid, key)
+        if self.tracer is not None and isinstance(item, dict) \
+                and not item.get("trace"):
+            # arrival reached the router without a trace (no edge in
+            # front): mint it HERE — router ingestion is the fleet's
+            # earliest common observation point
+            tid, root = self.tracer.mint(
+                "router.ingest", replica="router", t=self._clock(),
+                attrs={"uid": uid})
+            item["trace"] = {"id": tid, "parent": root}
         name = self._pick(key, exclude, item)
         if name is None:
             # DEAD/DRAINED/CLOSED are all terminal — none of them ever
@@ -589,6 +647,7 @@ class EngineRouter:
                     kind="request_failed", uid=uid, tick=self._tick,
                     detail="prompt can never fit any live replica's "
                            "max_seq_len"))
+                self._request_failed_trace(item, "unservable prompt")
                 logger.warning(f"router: uid={uid} failed — prompt fits "
                                "no live replica's max_seq_len")
                 return False
@@ -600,7 +659,30 @@ class EngineRouter:
         self.counters["placements"] += 1
         self.placements_by_engine[name] = \
             self.placements_by_engine.get(name, 0) + 1
+        tr = self._trace_of(item)
+        if self.tracer is not None and tr:
+            self.tracer.instant(
+                tr["id"], "router.place", self._clock(),
+                parent=tr.get("parent"), replica="router",
+                attrs={"uid": uid, "replica": name,
+                       "resumed": bool(isinstance(item, dict)
+                                       and item.get("generated"))})
+        self._flight_note("placement", replica=name, uid=uid,
+                          tick=self._tick,
+                          trace=tr.get("id") if tr else None)
         return True
+
+    def _request_failed_trace(self, item, detail: str) -> None:
+        """A request died AT THE ROUTER (unservable / re-route budget):
+        close its trace with a failed status — always sampled."""
+        tr = self._trace_of(item)
+        if self.tracer is not None and tr:
+            self.tracer.mark(tr["id"], "fault")
+            self.tracer.finish(tr["id"], self._clock(),
+                               status=f"failed:{detail}")
+        self._flight_note("request_failed", uid=self._uid_of(item),
+                          tick=self._tick, detail=detail,
+                          trace=tr.get("id") if tr else None)
 
     # ------------------------------------------------------------------
     # failure handling
@@ -635,6 +717,7 @@ class EngineRouter:
                 detail=f"re-route budget exhausted after {hops - 1} "
                        f"failovers (max_reroute_retries="
                        f"{self.cfg.max_reroute_retries})"))
+            self._request_failed_trace(item, "re-route budget exhausted")
             logger.warning(f"router: uid={uid} failed — re-route budget "
                            "exhausted")
             return
@@ -679,6 +762,24 @@ class EngineRouter:
         r.feed.clear()
         resumed = self._restamp_affinity(
             snapshot_split(snapshot or {"version": 1, "requests": []}))
+        # flight recorder: the failure event itself (engine_crash carries
+        # a crash snapshot — an auto-dump kind), then replica death
+        self._flight_note(kind, replica=r.name, tick=tick, detail=detail,
+                          orphans=len(orphans), resumed=len(resumed))
+        if r.status == DEAD:
+            self._flight_note("replica_dead", replica=r.name, tick=tick,
+                              detail=f"{kind}: {detail}")
+        for item in orphans + resumed:
+            # failed-over traces are ALWAYS sampled, and the failover hop
+            # is visible in the span tree
+            tr = self._trace_of(item)
+            if self.tracer is not None and tr:
+                self.tracer.mark(tr["id"], "failover")
+                self.tracer.instant(
+                    tr["id"], "router.failover", self._clock(),
+                    parent=tr.get("parent"), replica="router",
+                    attrs={"uid": self._uid_of(item), "from": r.name,
+                           "kind": kind})
         for item in orphans:
             self._route_failover(item, tick, exclude)
         for item in resumed:
@@ -709,6 +810,7 @@ class EngineRouter:
                 r.status = HEALTHY
                 r.rejoin_tick = None
                 self.counters["rejoins"] += 1
+                self._flight_note("rejoin", replica=r.name, tick=tick)
                 logger.warning(f"router: replica {r.name} rejoining at "
                                f"tick {tick} (failure {r.failures}/"
                                f"{self.cfg.max_engine_failures})")
@@ -728,6 +830,12 @@ class EngineRouter:
             if b.t - step_t0 > cfg.heartbeat_timeout_s:
                 r.missed_heartbeats += 1
                 self.counters["heartbeat_misses"] += 1
+                self._flight_note(
+                    "heartbeat_miss", replica=r.name, tick=tick,
+                    detail=f"frame {b.t - step_t0:.3f}s > "
+                           f"{cfg.heartbeat_timeout_s}s "
+                           f"({r.missed_heartbeats}/"
+                           f"{cfg.max_missed_heartbeats})")
                 if r.missed_heartbeats >= cfg.max_missed_heartbeats:
                     out = (f"{r.missed_heartbeats} consecutive frames "
                            f"slower than heartbeat_timeout_s="
@@ -820,6 +928,7 @@ class EngineRouter:
         r.status = DRAINING
         r.engine.begin_drain()
         self.counters["drains"] += 1
+        self._flight_note("drain_begin", replica=name, tick=tick)
         logger.warning(f"router: draining replica {name} at tick {tick}")
 
     def _finish_drain(self, r: _Replica, tick: int) -> None:
@@ -908,6 +1017,10 @@ class EngineRouter:
         if not ev.published:
             self.counters["handoffs_unpublished"] += 1
         self._assignment.pop(ev.uid, None)
+        tr = self._trace_of(ev.arrival)
+        self._flight_note("handoff", replica=r.name, uid=ev.uid, tick=tick,
+                          published=ev.published,
+                          trace=tr.get("id") if tr else None)
         self._restamp_affinity([ev.arrival])
         self._place(ev.arrival)
 

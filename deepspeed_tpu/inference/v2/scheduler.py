@@ -56,6 +56,7 @@ byte-for-byte.
 """
 
 import dataclasses
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -185,6 +186,9 @@ class ShedReason:
     queue_depth: int
     ttft_p90_ms: Optional[float]
     slo_ms: Optional[float]
+    # monotonic shed time: orders shed records against the crash flight
+    # recorder's event ring (tracing.py) in a postmortem bundle
+    t: Optional[float] = None
 
 
 class RequestScheduler:
@@ -366,7 +370,8 @@ class RequestScheduler:
             uid=req.uid, tenant=req.tenant,
             priority=PRIORITY_NAMES[req.priority], reason=reason,
             risk=round(self.risk, 4), queue_depth=self.queued_count(),
-            ttft_p90_ms=slo.get("ttft_p90_ms"), slo_ms=req.slo_ms)
+            ttft_p90_ms=slo.get("ttft_p90_ms"), slo_ms=req.slo_ms,
+            t=time.monotonic())
         self.shed_log.append(rec)
         self.summary["shed_by_class"][rec.priority] += 1
         return rec

@@ -19,6 +19,11 @@ MII serving tier of the reference stack (arXiv 2207.00032):
 * ``autoscale`` — ``AutoscaleController``: closes the loop over
                   ``drain()``/rejoin and flips unified replicas
                   prefill<->decode from queued-prompt-token pressure.
+
+The edge also wires fleet-wide distributed tracing + the crash flight
+recorder (``..tracing``; README "Distributed tracing & flight
+recorder"): every request carries one trace id end to end, served at
+``GET /debug/trace`` / ``GET /debug/flight``.
 """
 
 from .autoscale import AutoscaleConfig, AutoscaleController

@@ -77,6 +77,7 @@ class AutoscaleController:
     def __init__(self, config: Optional[AutoscaleConfig] = None):
         self.cfg = config or AutoscaleConfig()
         self.events: List[Dict] = []
+        self._flight = None                # router's FlightRecorder (if any)
         self._parked: List[str] = []       # names this controller drained
         self._flipped: Dict[str, str] = {}  # name -> original role
         self._flip_t: Dict[str, float] = {}  # name -> last flip clock
@@ -90,6 +91,12 @@ class AutoscaleController:
               detail: str) -> None:
         self.events.append(dict(tick=tick, action=action, replica=replica,
                                 detail=detail))
+        if self._flight is not None:
+            # autoscale actions belong in the crash flight ring: a death
+            # right after a drain/flip is exactly the sequence a
+            # postmortem needs to see
+            self._flight.record(f"autoscale_{action}", replica=replica,
+                                tick=tick, detail=detail)
         logger.warning(f"autoscale: {action} {replica} at tick {tick} "
                        f"({detail})")
 
@@ -108,6 +115,7 @@ class AutoscaleController:
             return
         self._last_eval = now
         rt = driver.router
+        self._flight = rt.flight
         live = {n: r for n, r in rt._replicas.items()
                 if r.status == HEALTHY}
         if not live:

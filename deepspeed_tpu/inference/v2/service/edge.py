@@ -30,8 +30,17 @@ dependencies):
   engine's existing deadline/cancel path, freeing its slot and KV blocks
   at the next frame boundary.
 * ``GET /metrics`` — ``ds_edge_*`` series + the whole fleet's
-  ``ds_router_*``/``ds_serving_*`` exposition in one scrape;
+  ``ds_router_*``/``ds_serving_*`` exposition (including the fleet-merged
+  ``ds_fleet_ttft_ms``/``ds_fleet_e2e_ms`` trace attribution and the
+  ``ds_trace_*``/``ds_flight_*`` series) in one scrape;
   ``GET /healthz`` — replica status + driver stats as JSON.
+* **Distributed tracing + flight recorder** (``..tracing``) — the edge
+  mints a trace id per request (the root span is the client's view:
+  bytes in → last SSE write) and wires the fleet's ``TraceCollector``/
+  ``FlightRecorder`` through the router; ``GET /debug/trace`` serves
+  Chrome-trace/Perfetto JSON (``?uid=``/``?trace=`` per-request,
+  ``&format=jsonl`` raw spans), ``GET /debug/flight`` the live
+  postmortem bundle.
 """
 
 import http.server
@@ -73,6 +82,20 @@ class EdgeConfig:
     # non-streaming requests give up after this long (the engine-side
     # deadline_ms is the real mechanism; this is the HTTP backstop)
     sync_timeout_s: float = 600.0
+    # ---- distributed tracing + crash flight recorder (tracing.py;
+    # README "Distributed tracing & flight recorder") ----
+    # mint a trace id per request at the edge and wire the fleet's
+    # TraceCollector/FlightRecorder through the router (False leaves the
+    # fleet untraced unless the caller attached its own)
+    trace: bool = True
+    # fraction of COMPLETED traces retained (faulted/shed/handed-off/
+    # failed-over/cancelled requests are ALWAYS retained)
+    trace_sample_rate: float = 1.0
+    trace_max_traces: int = 512
+    # flight-recorder ring length + postmortem dump directory (None =
+    # bundles kept in memory only; services should point this at disk)
+    flight_events: int = 1024
+    flight_dir: Optional[str] = None
 
 
 class ServiceEdge:
@@ -80,7 +103,8 @@ class ServiceEdge:
     docstring). ``start()`` binds the server (``edge_port`` holds the
     bound port); ``shutdown()`` stops accepting and closes."""
 
-    def __init__(self, driver, config: Optional[EdgeConfig] = None):
+    def __init__(self, driver, config: Optional[EdgeConfig] = None,
+                 tracer=None, recorder=None):
         self.driver = driver
         self.cfg = config or EdgeConfig()
         self._uids = itertools.count(1)
@@ -94,6 +118,23 @@ class ServiceEdge:
             streams_active=0, queued_tokens=0, retry_after_s=0.0)
         self._srv = None
         self._thread = None
+        # distributed tracing + flight recorder, wired fleet-wide through
+        # the router (every replica's telemetry + the placement/failover
+        # paths); pass tracer=/recorder= to share externally-built ones
+        self.tracer = None
+        self.flight = None
+        self._traces: Dict[int, str] = {}   # live uid -> trace id
+        self._sse_spans: Dict[int, int] = {}   # uid -> sse.write instants
+        if self.cfg.trace or tracer is not None:
+            from ..tracing import FlightRecorder, TraceCollector
+            tracer = tracer if tracer is not None else TraceCollector(
+                sample_rate=self.cfg.trace_sample_rate,
+                max_traces=self.cfg.trace_max_traces)
+            recorder = recorder if recorder is not None else FlightRecorder(
+                collector=tracer, max_events=self.cfg.flight_events,
+                dump_dir=self.cfg.flight_dir)
+            self.tracer, self.flight = \
+                driver.router.attach_tracing(tracer, recorder)
 
     def _inc(self, name: str, delta: int = 1) -> None:
         with self._lock:
@@ -187,20 +228,79 @@ class ServiceEdge:
                 item[key] = body[key]
         return item
 
+    # ------------------------------------------------------------------
+    # distributed-trace plumbing (no-ops when tracing is off)
+    # ------------------------------------------------------------------
+
+    def _trace_instant(self, uid: int, name: str,
+                       attrs: Optional[Dict] = None) -> None:
+        if self.tracer is None:
+            return
+        tid = self._traces.get(uid)
+        if tid is not None:
+            if name == "sse.write":
+                # cap per request, like the engine's emit instants: a
+                # long stream must not spend the trace's span budget on
+                # write markers before its terminal spans land
+                n = self._sse_spans.get(uid, 0)
+                if n >= 64:
+                    return
+                self._sse_spans[uid] = n + 1
+            # the root span id is "s0" by mint() construction
+            self.tracer.instant(tid, name, parent="s0", replica="edge",
+                                attrs={"uid": uid, **(attrs or {})})
+
+    def _trace_close(self, uid: int, outcome: str,
+                     mark: Optional[str] = None) -> None:
+        """End the edge's view of the request: extend/close the root span
+        (idempotent with the engine's retire-side finish)."""
+        if self.tracer is None:
+            return
+        with self._lock:
+            tid = self._traces.pop(uid, None)
+            self._sse_spans.pop(uid, None)
+        if tid is None:
+            return
+        if mark is not None:
+            self.tracer.mark(tid, mark)
+        self.tracer.finish(tid, status=f"edge:{outcome}")
+        if self.flight is not None and outcome in ("disconnect", "timeout",
+                                                   "error"):
+            self.flight.record("edge_" + outcome, uid=uid, trace=tid)
+
     def handle_generate(self, body: Dict):
         """Shared core of the POST handler (unit-testable without
         sockets): returns ``("shed", verdict)`` or
         ``("stream", uid, events_queue)``. The caller owns consuming the
         queue and cancelling on disconnect."""
         item = self._parse_request(body)
+        uid = item["uid"]
+        tid = None
+        if self.tracer is not None:
+            # the trace starts the moment the edge accepted the bytes —
+            # fleet TTFT/E2E are measured from HERE, the client's view
+            tid, root = self.tracer.mint("edge.recv", replica="edge",
+                                         attrs={"uid": uid})
+            item["trace"] = {"id": tid, "parent": root}
+            with self._lock:
+                self._traces[uid] = tid
         verdict = self.admission_check()
+        if self.tracer is not None:
+            self.tracer.instant(
+                tid, "edge.admit", parent=root, replica="edge",
+                attrs={"uid": uid,
+                       "verdict": "shed" if verdict else "admitted"})
         if verdict is not None:
             self._inc("sheds")
+            if self.flight is not None:
+                self.flight.record("edge_shed", uid=uid, trace=tid,
+                                   detail=verdict["reason"][:160])
+            self._trace_close(uid, "shed", mark="shed")
             return ("shed", verdict)
         events: queue.Queue = queue.Queue()
         self._inc("requests")
         self.driver.submit(item, subscriber=events.put)
-        return ("stream", item["uid"], events)
+        return ("stream", uid, events)
 
     def start(self):
         """Bind + serve on a daemon thread; returns self (``edge_port``
@@ -233,7 +333,8 @@ class ServiceEdge:
 
             # -- endpoints -----------------------------------------------
             def do_GET(self):
-                path = self.path.split("?")[0].rstrip("/")
+                raw_path, _, query = self.path.partition("?")
+                path = raw_path.rstrip("/")
                 if path in ("", "/metrics"):
                     body = edge.render_prometheus().encode()
                     self.send_response(200)
@@ -248,8 +349,51 @@ class ServiceEdge:
                         "stats": edge.driver.stats(),
                         "edge": {"counters": dict(edge.counters),
                                  "gauges": dict(edge.gauges)}})
+                elif path == "/debug/trace":
+                    self._debug_trace(query)
+                elif path == "/debug/flight":
+                    if edge.flight is None:
+                        self.send_error(404, "flight recorder disabled")
+                    else:
+                        self._json(200, edge.flight.bundle("http"))
                 else:
                     self.send_error(404)
+
+            def _debug_trace(self, query: str):
+                """``GET /debug/trace`` — the fleet's retained traces as
+                Chrome-trace-event JSON (load in chrome://tracing or
+                Perfetto). ``?trace=<id>`` / ``?uid=<n>`` narrow to one
+                request; ``&format=jsonl`` returns raw span lines (the
+                ``dstpu_trace`` CLI input)."""
+                if edge.tracer is None:
+                    self.send_error(404, "tracing disabled")
+                    return
+                import urllib.parse
+                q = urllib.parse.parse_qs(query)
+                if q.get("trace") or q.get("uid"):
+                    try:
+                        uid = int(q["uid"][0]) if q.get("uid") else None
+                    except ValueError:
+                        self._json(400, {"error": "uid must be an int"})
+                        return
+                    tr = edge.tracer.get(
+                        trace_id=(q.get("trace") or [None])[0], uid=uid)
+                    if tr is None:
+                        self.send_error(404, "no such trace")
+                        return
+                    traces = [tr]
+                else:
+                    traces = edge.tracer.traces()
+                if (q.get("format") or [""])[0] == "jsonl":
+                    body = edge.tracer.export_jsonl(traces).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/x-ndjson")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json(200, edge.tracer.export_chrome(traces))
 
             def do_POST(self):
                 if self.path.split("?")[0].rstrip("/") != "/v1/generate":
@@ -337,6 +481,8 @@ class ServiceEdge:
                             "uid": uid, "tokens": ev["tokens"],
                             "index": n_sent})
                         n_sent += len(ev["tokens"])
+                        edge._trace_instant(uid, "sse.write",
+                                            {"n": len(ev["tokens"])})
                     elif ev["type"] == "done":
                         self._sse_event("done", {
                             "uid": uid, "tokens": ev["tokens"],
@@ -357,11 +503,15 @@ class ServiceEdge:
                     edge._inc("disconnects")
                     edge._inc("cancelled")
                     edge.driver.cancel(uid)
+                    edge._trace_close(uid, "disconnect",
+                                      mark="disconnect")
                     self.close_connection = True
                 elif outcome == "done":
                     edge._inc("completed")
+                    edge._trace_close(uid, "done")
                 else:
                     edge._inc("errors")
+                    edge._trace_close(uid, "error")
 
             def _respond_sync(self, uid, events):
                 final = {}
@@ -374,16 +524,19 @@ class ServiceEdge:
                                         deadline_s=edge.cfg.sync_timeout_s)
                 if outcome == "done":
                     edge._inc("completed")
+                    edge._trace_close(uid, "done")
                     self._json(200, {"uid": uid, "tokens": final["tokens"],
                                      "n": len(final["tokens"])})
                 elif outcome == "error":
                     edge._inc("errors")
+                    edge._trace_close(uid, "error")
                     self._json(500, {"uid": uid, "error":
                                      final.get("reason", "failed"),
                                      "detail": final.get("detail", "")})
                 else:
                     edge._inc("errors")
                     edge.driver.cancel(uid)
+                    edge._trace_close(uid, "timeout", mark="cancelled")
                     self._json(504, {"uid": uid, "error": "timeout"})
 
         class _Server(http.server.ThreadingHTTPServer):

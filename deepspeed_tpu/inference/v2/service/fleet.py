@@ -660,6 +660,18 @@ class FleetDriver:
             self._reports[name] = payload
             self._stream_emissions(b)
             self._notify_terminal(payload)
+            if rt.flight is not None:
+                # engine-side faults/sheds ride the boundary report into
+                # the fleet flight ring (the postmortem wants the events
+                # that PRECEDED a death, wherever they happened)
+                for f in payload.new_faults:
+                    rt.flight.record(
+                        "engine_fault", replica=name,
+                        uid=f.uid if f.uid >= 0 else None, tick=tick,
+                        fault=f.kind, detail=f.detail[:160])
+                for s in payload.new_sheds:
+                    rt.flight.record("shed", replica=name, uid=s.uid,
+                                     tick=tick, detail=s.reason)
             hb_fail = rt._note_heartbeat(r, b, tick, payload.step_t0)
             if hb_fail is not None and r.status in (HEALTHY, DRAINING) \
                     and not r.halt.is_set():
@@ -740,6 +752,8 @@ class FleetDriver:
                 rt.fault_log.append(RouterFault(
                     kind="role_flip", tick=tick, engine=r.name,
                     detail=f"role -> {new_role}"))
+                rt._flight_note("role_flip", replica=r.name, tick=tick,
+                                detail=f"role -> {new_role}")
             except Exception as e:    # noqa: BLE001 — keep the old role
                 logger.warning(f"FleetDriver: role flip of {r.name} to "
                                f"{new_role} failed: {e}")
@@ -861,14 +875,14 @@ class FleetDriver:
                     coll.remove(entry)
                     rt._finish(uid)
                     rt.counters["completions"] -= 1   # not a completion
-                    self._notify_cancelled(uid)
+                    self._notify_cancelled(uid, item=entry[0])
                     return
         for entry in list(rt._deferred):
             if self._uid_of_parked(entry[1]) == uid:
                 rt._deferred.remove(entry)
                 rt._finish(uid)
                 rt.counters["completions"] -= 1
-                self._notify_cancelled(uid)
+                self._notify_cancelled(uid, item=entry[1])
                 return
         name = rt._assignment.get(uid)
         if name is None:
@@ -882,7 +896,7 @@ class FleetDriver:
                     r.feed.drained += 1
                     rt._finish(uid)
                     rt.counters["completions"] -= 1
-                    self._notify_cancelled(uid)
+                    self._notify_cancelled(uid, item=item)
                     return
         # the engine owns it: cancel through the deadline path (the
         # boundary frees the slot + KV blocks; the reap below clears the
@@ -899,7 +913,7 @@ class FleetDriver:
                 logger.warning(f"FleetDriver: cancel of uid={uid} gave up "
                                "after 1000 retries (request in transit)")
 
-    def _notify_cancelled(self, uid: int) -> None:
+    def _notify_cancelled(self, uid: int, item=None) -> None:
         sub = self._subs.pop(uid, None)
         self._streamed.pop(uid, None)
         self._place_seq.pop(uid, None)
@@ -908,6 +922,15 @@ class FleetDriver:
         # are orphaned now — only the router can release them (engines
         # drop records only for requests they retire themselves)
         self.router._drop_tier_record(uid)
+        rt = self.router
+        tr = rt._trace_of(item) if item is not None else None
+        if rt.tracer is not None and tr:
+            # a request cancelled before any engine saw it still ends its
+            # trace (the engine-side cancel path marks in-flight ones)
+            rt.tracer.mark(tr["id"], "cancelled")
+            rt.tracer.finish(tr["id"], self._clock(), status="cancelled")
+        rt._flight_note("cancel", uid=uid, tick=self._tick,
+                        trace=tr.get("id") if tr else None)
         if sub is not None:
             self._safe_sub(sub, {"type": "error", "uid": uid,
                                  "reason": "cancelled"})

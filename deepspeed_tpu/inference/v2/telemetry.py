@@ -173,7 +173,8 @@ class LogBucketHistogram:
 
 class _Span:
     __slots__ = ("uid", "enqueue_t", "admit_t", "first_token_t",
-                 "last_emit_t", "tokens", "tenant", "pclass", "resumed")
+                 "last_emit_t", "tokens", "emit_spans", "tenant", "pclass",
+                 "resumed", "trace", "parent")
 
     def __init__(self, uid: int, enqueue_t: float,
                  tenant: Optional[str] = None, pclass: Optional[str] = None,
@@ -184,14 +185,23 @@ class _Span:
         self.first_token_t: Optional[float] = None
         self.last_emit_t: Optional[float] = None
         self.tokens = 0
+        self.emit_spans = 0         # per-frame emit instants recorded
         self.tenant = tenant        # scheduler metadata (None without one)
         self.pclass = pclass
         # a resume arrival (router failover / drain migration / prefill→
         # decode handoff) already emitted its true first token on another
         # engine: this engine's first emission is a CONTINUATION, not a
-        # TTFT sample — recording it would pollute the fleet-merged TTFT
-        # histograms the disaggregation bench compares
+        # TTFT sample — recording it would pollute the per-replica TTFT
+        # histograms the disaggregation bench compares. The fleet-merged
+        # ``ds_fleet_ttft_ms`` attribution lives in tracing.TraceCollector
+        # (one sample per TRACE id, spanning handoff/failover).
         self.resumed = resumed
+        # distributed-trace context (tracing.py): the fleet-wide trace id
+        # this request rides, and the span id engine spans parent to (the
+        # trace's root) — both carried in from the arrival dict, or minted
+        # locally when a tracer is attached and the arrival had none
+        self.trace: Optional[str] = None
+        self.parent: Optional[str] = None
 
 
 class ServingTelemetry:
@@ -204,6 +214,10 @@ class ServingTelemetry:
     """
 
     HIST_NAMES = ("ttft", "itl", "queue_wait", "e2e")
+    #: per-request ceiling on per-frame "emit" instant spans (tracing):
+    #: keeps a long generation from exhausting the collector's per-trace
+    #: span budget before its terminal spans are recorded
+    MAX_EMIT_SPANS = 64
 
     def __init__(self, enabled: bool = True, trace: bool = False,
                  clock=time.monotonic, record_spans: bool = False,
@@ -228,6 +242,11 @@ class ServingTelemetry:
         # metric identity. Lives OUTSIDE reset(): identity outlives serve
         # runs. Empty (the default) keeps the exposition byte-identical.
         self.base_labels: Dict[str, str] = {}
+        # distributed tracing (tracing.TraceCollector): like base_labels,
+        # identity/wiring that outlives serve runs. None (the default)
+        # keeps every hook's fast path unchanged.
+        self.tracer = None
+        self.trace_replica: Optional[str] = None
         # monitor step: monotonic across serve() runs (reset() zeroes the
         # per-serve frame counter, but an attached TensorBoard/CSV writer
         # must never see its step axis jump back to zero)
@@ -350,6 +369,32 @@ class ServingTelemetry:
             else:
                 self.base_labels[k] = str(v)
 
+    def set_tracer(self, tracer, replica: Optional[str] = None) -> None:
+        """Attach a ``tracing.TraceCollector`` (or None to detach):
+        lifecycle hooks then emit frame-boundary-stamped spans into the
+        fleet-wide trace each request carries (minting a trace locally
+        when an arrival has none). ``replica`` labels this engine's spans
+        — the router stamps its replica name, mirroring
+        ``set_base_labels``. Requires ``enabled=True`` (the hooks that
+        stamp spans are the host lifecycle hooks)."""
+        self.tracer = tracer
+        if replica is not None:
+            self.trace_replica = replica
+
+    def _trace_span(self, span, name: str, t0: float, t1=None,
+                    status: Optional[str] = None,
+                    attrs: Optional[Dict] = None) -> None:
+        """Emit one span for an open request into the attached tracer
+        (no-op without one); parents to the trace root carried in the
+        arrival so the cross-replica tree stays connected."""
+        if self.tracer is None or span is None or span.trace is None:
+            return
+        a = {"uid": span.uid}
+        if attrs:
+            a.update(attrs)
+        self.tracer.span(span.trace, name, t0, t1, parent=span.parent,
+                         replica=self.trace_replica, status=status, attrs=a)
+
     def _labelstr(self, extra: str = "") -> str:
         """Render ``{...}`` merging the base identity labels with
         ``extra`` (a pre-rendered ``k="v",...`` fragment); empty when
@@ -379,12 +424,29 @@ class ServingTelemetry:
 
     def on_enqueue(self, uid: int, tenant: Optional[str] = None,
                    pclass: Optional[str] = None,
-                   resumed: bool = False) -> None:
+                   resumed: bool = False,
+                   trace: Optional[Dict] = None) -> Optional[Dict]:
+        """``trace`` is the distributed-trace context the arrival carried
+        (``{"id", "parent"}``, minted at the edge/router); with a tracer
+        attached and no context, a trace is minted HERE — a bare engine
+        (tuple arrivals) still yields one connected tree per request.
+        Returns the EFFECTIVE context so the engine can write a locally
+        minted one back into its ledger — without that, a failover/
+        handoff resume of a tuple arrival would start a second tree."""
         if not self.enabled:
-            return
+            return trace
         self.counters["requests_enqueued"] += 1
-        self._open_spans[uid] = _Span(uid, self.clock(), tenant, pclass,
-                                      resumed=resumed)
+        span = _Span(uid, self.clock(), tenant, pclass, resumed=resumed)
+        if self.tracer is not None:
+            if not trace:
+                tid, root = self.tracer.mint(
+                    "engine.recv", replica=self.trace_replica,
+                    t=span.enqueue_t, attrs={"uid": uid})
+                trace = {"id": tid, "parent": root}
+            span.trace = trace.get("id")
+            span.parent = trace.get("parent")
+        self._open_spans[uid] = span
+        return trace
 
     def on_admit(self, uid: int) -> None:
         if not self.enabled:
@@ -404,6 +466,8 @@ class ServingTelemetry:
         self.hists["queue_wait"].record(wait)
         self._win["queue_wait"].append(wait)
         self._inc_labeled("requests_admitted", self._labels(span))
+        self._trace_span(span, "engine.queue", span.enqueue_t,
+                         span.admit_t)
 
     def on_emit(self, uid: int, n_tokens: int) -> None:
         """``n_tokens`` emitted to ``uid`` at this frame boundary."""
@@ -422,9 +486,27 @@ class ServingTelemetry:
                 if span.pclass is not None:
                     self.class_ttft.setdefault(
                         span.pclass, LogBucketHistogram()).record(ttft)
+            # first emission on THIS engine: the prefill (or, for a
+            # resumed request, the restore + re-prefill) phase ends here.
+            # The collector keys fleet TTFT by TRACE id — only the first
+            # replica to emit records a sample, so a handed-off/failed-
+            # over request gets exactly one true first-token time.
+            self._trace_span(
+                span, "engine.restore" if span.resumed else
+                "engine.prefill", span.admit_t or span.enqueue_t, now)
+            if self.tracer is not None and span.trace is not None:
+                self.tracer.note_first_token(span.trace, now)
         else:
             gap = max(0.0, now - span.last_emit_t)
             self.hists["itl"].record(gap / n_tokens, count=n_tokens)
+        # cap the per-frame emit instants per REQUEST: a long generation
+        # would otherwise spend the trace's whole span budget on emit
+        # markers and truncate the terminal spans (decode/handoff/
+        # restore) that tracing exists to show — the decode span's
+        # ``tokens`` attr carries the total anyway
+        if span.emit_spans < self.MAX_EMIT_SPANS:
+            span.emit_spans += 1
+            self._trace_span(span, "emit", now, attrs={"n": n_tokens})
         span.last_emit_t = now
         span.tokens += n_tokens
         self._inc_labeled("tokens_emitted", self._labels(span), n_tokens)
@@ -439,6 +521,15 @@ class ServingTelemetry:
         self.counters["requests_retired"] += 1
         self.hists["e2e"].record(now - span.enqueue_t)
         self._inc_labeled("requests_retired", self._labels(span))
+        if span.first_token_t is not None:
+            self._trace_span(span, "engine.decode", span.first_token_t,
+                             now, attrs={"tokens": span.tokens})
+        if self.tracer is not None and span.trace is not None:
+            # the retiring replica ends the fleet-level request: one E2E
+            # sample per trace id, and the root span closes "ok" (the
+            # edge may still extend the root to cover its last SSE write)
+            self.tracer.note_done(span.trace, now)
+            self.tracer.finish(span.trace, now, status="ok")
         if self.record_spans:
             rec = {
                 "uid": span.uid, "enqueue_t": span.enqueue_t,
@@ -462,6 +553,12 @@ class ServingTelemetry:
         span = self._open_spans.pop(uid, None)
         if span is not None:
             self._inc_labeled("requests_shed", self._labels(span))
+            if self.tracer is not None and span.trace is not None:
+                # shed traces are ALWAYS sampled — overload rejections
+                # are exactly what a uniform sampler would lose
+                self.tracer.mark(span.trace, "shed")
+                self.tracer.finish(span.trace, self.clock(),
+                                   status=f"shed:{reason or 'unknown'}")
         elif tenant is not None or pclass is not None:
             self._inc_labeled("requests_shed",
                               (("class", pclass or "unknown"),
@@ -476,6 +573,7 @@ class ServingTelemetry:
         span = self._open_spans.get(uid)
         if span is not None:
             self._inc_labeled("requests_preempted", self._labels(span))
+            self._trace_span(span, "preempt", self.clock())
         elif tenant is not None or pclass is not None:
             self._inc_labeled("requests_preempted",
                               (("class", pclass or "unknown"),
@@ -504,7 +602,17 @@ class ServingTelemetry:
         elif kind == "slow_frame":
             self.counters["slow_frames"] += 1
         if uid is not None:
-            self._open_spans.pop(uid, None)
+            span = self._open_spans.pop(uid, None)
+            if span is not None and self.tracer is not None \
+                    and span.trace is not None:
+                # faulted traces are ALWAYS sampled; a request-terminal
+                # fault ends the fleet-level request (status = the kind)
+                self.tracer.mark(span.trace,
+                                 "cancelled" if kind == "cancelled"
+                                 else "fault")
+                # no note_done: faulted requests stay out of the fleet
+                # E2E histogram, mirroring the per-replica semantics
+                self.tracer.finish(span.trace, self.clock(), status=kind)
 
     def on_recover(self, n_requests: int, recovery_ms: float) -> None:
         """A ``serve(..., resume_from=)`` run re-admitted ``n_requests``
@@ -548,22 +656,37 @@ class ServingTelemetry:
         self.counters["prefix_blocks_swapped_in"] += swapped_in
         self.gauges["prefix_blocks_resident"] = resident
 
-    def on_kv_swap_out(self, n_blocks: int) -> None:
-        """A preemption victim's committed pages left for the host tier."""
+    def on_kv_swap_out(self, n_blocks: int, uid: Optional[int] = None,
+                       publish: bool = False) -> None:
+        """A request's committed pages left for the host tier — a
+        preemption victim's swap-out, or (``publish=True``) a prefill
+        replica's tier publish on the handoff path; ``uid`` stamps the
+        tier I/O into the request's distributed trace."""
         if not self.enabled:
             return
         self.counters["kv_swap_out_requests"] += 1
         self.counters["kv_swap_out_blocks"] += n_blocks
+        if uid is not None:
+            self._trace_span(self._open_spans.get(uid),
+                             "tier.publish" if publish else "kv.swap_out",
+                             self.clock(), attrs={"blocks": n_blocks})
 
-    def on_kv_swap_in(self, n_blocks: int, resume: bool = False) -> None:
+    def on_kv_swap_in(self, n_blocks: int, resume: bool = False,
+                      uid: Optional[int] = None) -> None:
         """A request re-admitted by restoring its swapped pages (instead
-        of re-prefilling); ``resume`` marks the crash-recovery path."""
+        of re-prefilling); ``resume`` marks the crash-recovery path.
+        ``uid`` stamps the restore into the request's distributed trace —
+        the decode-side restore span of a prefill→decode handoff."""
         if not self.enabled:
             return
         self.counters["kv_swap_in_requests"] += 1
         self.counters["kv_swap_in_blocks"] += n_blocks
         if resume:
             self.counters["kv_swap_resume_restores"] += 1
+        if uid is not None:
+            self._trace_span(self._open_spans.get(uid), "kv.restore",
+                             self.clock(),
+                             attrs={"blocks": n_blocks, "resume": resume})
 
     def on_handoff_out(self, uid: int, pipelined: bool = False) -> None:
         """A prefill-role engine finished ``uid``'s prefill, published its
@@ -579,7 +702,19 @@ class ServingTelemetry:
         self.counters["handoffs_out"] += 1
         if pipelined:
             self.counters["handoffs_pipelined"] += 1
-        self._open_spans.pop(uid, None)
+        span = self._open_spans.pop(uid, None)
+        if span is not None and self.tracer is not None \
+                and span.trace is not None:
+            now = self.clock()
+            self._trace_span(span, "engine.handoff",
+                             span.first_token_t or span.admit_t
+                             or span.enqueue_t, now, status="handoff",
+                             attrs={"pipelined": pipelined,
+                                    "tokens": span.tokens})
+            # handed-off traces are ALWAYS sampled; the trace stays OPEN
+            # — the decode replica owns the rest of its lifecycle and
+            # finishes it at retire
+            self.tracer.mark(span.trace, "handoff")
 
     def on_tier_prefix_hit(self, hit_tokens: int, n_blocks: int) -> None:
         """An admission restored a content-addressed prefix record from
