@@ -378,7 +378,13 @@ class CostReport:
 
     @property
     def int8_payload(self) -> int:
-        return self.payload_by_dtype.get("int8", 0)
+        """One-byte quantized wire bytes: int8 AND fp8 (e4m3/e5m2)
+        collective operands — both payload formats of the quantized
+        lowering, identical width, so GL202's <=0.5x-of-exact contract
+        applies to either. The metric keeps its historical
+        ``collective_payload_int8`` name (baseline schema)."""
+        return sum(v for k, v in self.payload_by_dtype.items()
+                   if k == "int8" or k.startswith("float8"))
 
     def metrics(self) -> Dict[str, int]:
         """The flat metric dict GL201 diffs against the baseline."""

@@ -428,6 +428,23 @@ def check_retrace(prog: TracedProgram) -> List[Finding]:
     try:
         first = str(_closed(prog.traced()))       # cached first trace
         second = str(_closed(prog.retrace()))     # fresh build + trace
+        if first != second:
+            # jax's pretty printer hoists a pjit sub-jaxpr (jnp.where,
+            # floor_divide, ...) into a shared ``let _whereN = .. in``
+            # binding only when its call sites reuse the SAME cached
+            # jaxpr object, and whether they do depends on global
+            # tracing-cache LRU state left behind by whatever else the
+            # registry traced in between — so two semantically identical
+            # traces can print differently on cache warmth alone.
+            # Confirm on a level playing field: two fresh traces, each
+            # from a cold tracing cache. Real offenders (counters,
+            # dict/set order, wall-clock constants) still diverge
+            # cold-vs-cold; printer-sharing artifacts do not.
+            import jax
+            jax.clear_caches()
+            first = str(_closed(prog.retrace()))
+            jax.clear_caches()
+            second = str(_closed(prog.retrace()))
     except Exception as e:               # noqa: BLE001
         return [Finding(
             "GL004", JAXPR_PATH, 0,

@@ -32,7 +32,9 @@ from .jaxpr_checks import TracedProgram
 _GAMMA = 2
 
 
-def _tiny_engine(tp: int = 1, quantized: bool = False, overlap: bool = False):
+def _tiny_engine(tp: int = 1, quantized: bool = False, overlap: bool = False,
+                 payload: str = "int8", kv_dtype: Optional[str] = None,
+                 weight_dtype: Optional[str] = None):
     import jax
     from ..models import build_model
     from ..inference.v2.engine_v2 import (InferenceEngineV2,
@@ -42,7 +44,9 @@ def _tiny_engine(tp: int = 1, quantized: bool = False, overlap: bool = False):
     cfg = RaggedInferenceEngineConfig(
         kv_block_size=16, prefill_chunk_size=8, max_tokens_per_step=64,
         max_ragged_batch_size=4, frame_steps=2, dtype="float32", tp=tp,
-        tp_quantized_collectives=quantized, tp_overlap_collectives=overlap)
+        tp_quantized_collectives=quantized, tp_overlap_collectives=overlap,
+        tp_collective_payload=payload, kv_dtype=kv_dtype,
+        weight_dtype=weight_dtype)
     eng = InferenceEngineV2(model, cfg, params=params, max_seq_len=64)
     eng.attach_draft(model, params)    # self-draft: spec loops traceable
     return eng
@@ -184,8 +188,11 @@ def _engine_programs(eng, tag: str) -> List[TracedProgram]:
         # stall worth catching; identical program under tp via GSPMD)
         from ..inference.v2.kv_cache import BlockedKVCache
         bids = jnp.zeros((2,), jnp.int32)
+        # pool row width comes from kv.lanes: head_dim for float pools,
+        # head_dim + packed scale lanes for int8 pools — the movers ship
+        # whatever representation the pool holds
         pages = jnp.zeros((kv.num_layers, kv.kv_heads, 2, kv.block_size,
-                           kv.head_dim), kv.k.dtype)
+                           kv.lanes), kv.k.dtype)
         progs.append(_program(
             f"copy_blocks{tag}", BlockedKVCache._build_copy_blocks,
             (kv.k, kv.v, bids, bids), {}))
@@ -216,6 +223,14 @@ def build_serving_programs(include_tp: Optional[bool] = None
     test cross-checks that no serve() dispatch site exists outside it."""
     import jax
     progs = _engine_programs(_tiny_engine(tp=1), "")
+    # the quantized serving stack (kv_dtype/weight_dtype int8) compiles
+    # DISTINCT programs — int8 pools with packed scale lanes, dequant at
+    # the attention read, quantize at append, int8 weight dequant in every
+    # matmul — so each gets its own GL001-GL004 + Family C coverage; the
+    # page movers re-trace over int8 pools (the swap tier moves the
+    # quantized representation, which is the 2-4x tier-I/O claim)
+    progs += _engine_programs(
+        _tiny_engine(kv_dtype="int8", weight_dtype="int8"), "[quant]")
     if include_tp is None:
         include_tp = len(jax.devices()) >= 8
     if include_tp:
@@ -268,6 +283,12 @@ def build_cost_programs(include_tp: Optional[bool] = None
     if include_tp:
         progs += _variant_programs(_tiny_engine(tp=8, quantized=True),
                                    "[tp=8,quant]", "quantized")
+        # fp8 (e4m3) wire variant: same one-byte payload contract as int8,
+        # proven by the same GL202 comparison against the exact twins
+        # (CostReport.int8_payload counts float8_* collective operands too)
+        progs += _variant_programs(
+            _tiny_engine(tp=8, quantized=True, payload="fp8"),
+            "[tp=8,fp8]", "quantized")
         progs += _variant_programs(_tiny_engine(tp=8, overlap=True),
                                    "[tp=8,ring]", "overlap")
     return progs

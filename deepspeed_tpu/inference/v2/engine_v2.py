@@ -124,10 +124,14 @@ class RaggedInferenceEngineConfig:
     # only. tp=1 never touches shard_map — byte-identical to the unsharded
     # engine (serving_bench.py --tp asserts this inline).
     tp: int = 1
-    # int8-quantized all-reduce/all-gather for the per-step activation and
-    # logit exchanges (EQuARX, arXiv 2506.17615): opt-in, parity-at-
-    # tolerance (tests/test_serving_tp.py pins the contract)
+    # quantized all-reduce/all-gather for the per-step activation, masked-
+    # embedding, and logit exchanges (EQuARX, arXiv 2506.17615): opt-in,
+    # parity-at-tolerance (tests/test_serving_tp.py pins the contract)
     tp_quantized_collectives: bool = False
+    # wire format of the quantized exchanges: "int8" (symmetric absmax) or
+    # "fp8" (e4m3 scaled casts, Big-Send-off-style) — both one byte per
+    # element on the wire, proven <=0.5x exact traffic by graft-cost GL202
+    tp_collective_payload: str = "int8"
     # decompose the MLP all-reduce into ppermute ring chunks XLA can
     # schedule around neighboring compute (T3, arXiv 2401.16677): opt-in;
     # ring summation order differs from psum, so parity is at-tolerance
@@ -193,6 +197,20 @@ class RaggedInferenceEngineConfig:
     # False restores the publish-at-handoff behavior.
     handoff_pipeline: bool = True
     dtype: str = "bfloat16"
+    # ---- low-precision serving (README "Quantization") ----
+    # resident weight storage for the big matmuls (qkv/out/mlp/lm_head):
+    # None serves the checkpoint dtype; "int8" quantizes per output channel
+    # at engine build (model_implementations/quantize.py) and dequantizes
+    # in-graph at use — ~4x smaller resident weights vs f32, logit error
+    # bounded <=5% by the parity contract (tests/test_quantized_serving.py)
+    weight_dtype: Optional[str] = None
+    # paged KV pool storage: None keeps `dtype`; "int8" stores every page
+    # as packed absmax-quantized rows with per-(token, head) f32 scales in
+    # trailing int8 lanes (kv_cache.quantize_kv_lanes) — quantize at
+    # append, dequantize at attention read, and the page movers, swap
+    # tier, prefix publishes, and disagg handoffs all move the int8
+    # representation unchanged (records shrink with the pool)
+    kv_dtype: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -265,6 +283,23 @@ class InferenceEngineV2:
             self.params = jax.device_put(converted)
 
         c = self._config
+        if c.weight_dtype not in (None, "int8"):
+            raise ValueError(f"weight_dtype={c.weight_dtype!r}: expected "
+                             "None or 'int8'")
+        if c.kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype={c.kv_dtype!r}: expected None or "
+                             "'int8'")
+        if c.tp_collective_payload not in ("int8", "fp8"):
+            raise ValueError(
+                f"tp_collective_payload={c.tp_collective_payload!r}: "
+                "expected 'int8' or 'fp8'")
+        if c.weight_dtype and c.tp <= 1:
+            # tp>1 quantizes inside _init_tensor_parallel, jointly with the
+            # partition-spec tree (scales must shard with their weight)
+            from .model_implementations.quantize import quantize_params
+            self.params, _ = quantize_params(
+                self.params, self.model.logical_axes(),
+                weight_dtype=c.weight_dtype)
         bs = c.kv_block_size
         max_blocks_per_seq = (self.max_seq_len + bs - 1) // bs
         exp_ctx = min(c.expected_context or self.max_seq_len, self.max_seq_len)
@@ -274,7 +309,7 @@ class InferenceEngineV2:
         num_blocks = c.num_kv_blocks or (conc * per_seq + 1)
         self.kv = BlockedKVCache(cfg.num_layers, cfg.kv_heads, cfg.dims_per_head,
                                  num_blocks=num_blocks, block_size=bs,
-                                 dtype=cfg.act_dtype)
+                                 dtype=cfg.act_dtype, kv_dtype=c.kv_dtype)
         # block 0 is the trash block for padded writes — never allocate it
         self.kv.reserve_trash_block()
         self.state = DSStateManager(self.kv, c.max_tracked_sequences)
@@ -356,7 +391,18 @@ class InferenceEngineV2:
         c = self._config
         ctx = build_tp_context(self.model, c.tp,
                                quantized=c.tp_quantized_collectives,
-                               overlap=c.tp_overlap_collectives)
+                               overlap=c.tp_overlap_collectives,
+                               payload=c.tp_collective_payload)
+        if c.weight_dtype:
+            # transform params and specs JOINTLY: int8 q keeps the weight's
+            # spec, the keepdims scale gets the contracted entries nulled —
+            # shard_params tree-maps the two trees against each other, so
+            # they must stay mirrors
+            from .model_implementations.quantize import quantize_params
+            self.params, qspecs = quantize_params(
+                self.params, self.model.logical_axes(), ctx.param_specs,
+                weight_dtype=c.weight_dtype)
+            ctx = dataclasses.replace(ctx, param_specs=qspecs)
         self.tp_ctx = ctx
         self.params = ctx.shard_params(self.params)
         self.kv.shard(NamedSharding(ctx.mesh, ctx.kv_spec))
@@ -413,10 +459,17 @@ class InferenceEngineV2:
         else:
             self.draft_params = jax.device_put(converted)
         c = self._config
+        if c.weight_dtype and self.tp_ctx is None:
+            # the draft serves under the same storage contract as the
+            # target (tp>1 quantizes jointly with its specs below)
+            from .model_implementations.quantize import quantize_params
+            self.draft_params, _ = quantize_params(
+                self.draft_params, self.draft_model.logical_axes(),
+                weight_dtype=c.weight_dtype)
         self.draft_kv = BlockedKVCache(
             dcfg.num_layers, dcfg.kv_heads, dcfg.dims_per_head,
             num_blocks=self.kv.num_blocks, block_size=c.kv_block_size,
-            dtype=dcfg.act_dtype)
+            dtype=dcfg.act_dtype, kv_dtype=c.kv_dtype)
         self.draft_runner = PagedModelRunner(self.draft_model, c.kv_block_size,
                                              self.max_blocks_per_seq)
         if self.tp_ctx is not None:
@@ -429,7 +482,14 @@ class InferenceEngineV2:
             dctx = build_tp_context(self.draft_model, c.tp,
                                     quantized=c.tp_quantized_collectives,
                                     overlap=c.tp_overlap_collectives,
+                                    payload=c.tp_collective_payload,
                                     role="draft", mesh=self.tp_ctx.mesh)
+            if c.weight_dtype:
+                from .model_implementations.quantize import quantize_params
+                self.draft_params, dqs = quantize_params(
+                    self.draft_params, self.draft_model.logical_axes(),
+                    dctx.param_specs, weight_dtype=c.weight_dtype)
+                dctx = dataclasses.replace(dctx, param_specs=dqs)
             self.draft_params = dctx.shard_params(self.draft_params)
             self.draft_kv.shard(NamedSharding(dctx.mesh, dctx.kv_spec))
             self.draft_runner.set_tp(dctx)
@@ -1048,7 +1108,8 @@ class InferenceEngineV2:
         self.telemetry.begin_serve(speculate=speculate, gamma=gamma,
                                    adaptive=adaptive, n_slots=n_slots,
                                    kv_blocks_total=self.kv.num_blocks,
-                                   tp_degree=self._config.tp)
+                                   tp_degree=self._config.tp,
+                                   kv_block_bytes=self.kv.block_bytes)
         if scheduler is not None:
             scheduler.begin_serve(self)
             return self._serve_guarded_sched(

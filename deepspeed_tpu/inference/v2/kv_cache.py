@@ -9,6 +9,16 @@ the Pallas paged-decode kernel (``ops/pallas/paged_attention.py``) reads each
 (page, head) slab contiguously in place. A sequence's logical cache is the
 concatenation of its blocks; prefill chunks gather pages by block table (XLA
 gather), decode attends in place.
+
+Quantized pages (``kv_dtype="int8"``): the pools become int8 with the last
+dim widened to D + 4 *scale lanes* — each (token, head) row stores its D
+quantized values followed by its f32 absmax scale bitcast into 4 int8 lanes
+(``quantize_kv_lanes``/``dequantize_kv_lanes``). Packing the scale INTO the
+page row (ZeRO-Inference-style row quantization, arXiv 2207.00032) keeps
+every page a single int8 array, so block tables, the page movers, the swap
+tier, and the tensor-parallel head sharding all move the quantized
+representation unchanged — spill/restore ships the already-int8 bytes with
+zero conversion, and per-token pool bytes drop from 4D (f32) to D + 4.
 """
 
 import functools
@@ -23,20 +33,66 @@ from .blocked_allocator import BlockedAllocator
 # process-wide compiled page-movement helpers (see BlockedKVCache._fn)
 _PAGE_FNS = {}
 
+# int8 lanes appended to each quantized page row: one f32 per-(token, head)
+# absmax scale, bitcast so the page stays a single int8 array
+KV_SCALE_LANES = 4
+
+
+def quantize_kv_lanes(x):
+    """Quantize ``(..., D)`` float rows to packed ``(..., D + 4)`` int8 page
+    rows: symmetric absmax int8 values plus the f32 scale bitcast into the
+    trailing ``KV_SCALE_LANES`` lanes. All-zero rows get scale 0, so they
+    dequantize to exactly 0."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    q = jnp.where(scale > 0, jnp.round(x.astype(jnp.float32)
+                                       / jnp.where(scale > 0, scale, 1.0)), 0)
+    q = jnp.clip(q, -127, 127).astype(jnp.int8)
+    lanes = jax.lax.bitcast_convert_type(scale, jnp.int8)  # (..., 1, 4)
+    return jnp.concatenate(
+        [q, lanes.reshape(q.shape[:-1] + (KV_SCALE_LANES,))], axis=-1)
+
+
+def dequantize_kv_lanes(packed, dtype):
+    """Unpack ``(..., D + 4)`` int8 page rows to ``(..., D)`` in ``dtype``.
+    The scale is sanitized: never-written pool rows (and anything routed
+    through the trash block) hold arbitrary bytes whose bitcast can be
+    NaN/inf — those rows read as 0 instead of poisoning the attention."""
+    q = packed[..., :-KV_SCALE_LANES]
+    scale = jax.lax.bitcast_convert_type(
+        packed[..., -KV_SCALE_LANES:], jnp.float32)       # lanes collapse
+    scale = jnp.where(jnp.isfinite(scale), scale, 0.0)
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
 
 class BlockedKVCache:
     def __init__(self, num_layers: int, kv_heads: int, head_dim: int,
-                 num_blocks: int, block_size: int = 64, dtype=jnp.bfloat16):
+                 num_blocks: int, block_size: int = 64, dtype=jnp.bfloat16,
+                 kv_dtype: Optional[str] = None):
         self.num_layers = num_layers
         self.kv_heads = kv_heads
         self.head_dim = head_dim
         self.block_size = block_size
         self.num_blocks = num_blocks
-        shape = (num_layers, kv_heads, num_blocks, block_size, head_dim)
-        self.k = jnp.zeros(shape, dtype)
-        self.v = jnp.zeros(shape, dtype)
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(f"kv_dtype must be None or 'int8', "
+                             f"got {kv_dtype!r}")
+        self.quantized = kv_dtype == "int8"
+        # pool row width: head_dim floats, or head_dim int8 + scale lanes
+        self.lanes = head_dim + KV_SCALE_LANES if self.quantized else head_dim
+        pool_dtype = jnp.int8 if self.quantized else dtype
+        shape = (num_layers, kv_heads, num_blocks, block_size, self.lanes)
+        self.k = jnp.zeros(shape, pool_dtype)
+        self.v = jnp.zeros(shape, pool_dtype)
         self.allocator = BlockedAllocator(num_blocks)
         self._sharding = None       # set by shard(); places swap-in updates
+
+    @property
+    def block_bytes(self) -> int:
+        """Resident HBM bytes per block across BOTH pools — the unit the
+        byte-accounting telemetry multiplies block counts by."""
+        per_row = self.lanes * self.k.dtype.itemsize
+        return 2 * self.num_layers * self.kv_heads * self.block_size * per_row
 
     def blocks_for(self, num_tokens: int) -> int:
         return (num_tokens + self.block_size - 1) // self.block_size
@@ -91,6 +147,10 @@ class BlockedKVCache:
         block_ids: (max_blocks,) int32 block table of the sequence;
         start_pos: int, first logical slot to write; new_k/new_v: (L, S, KVH, D).
         """
+        if self.quantized:
+            raise NotImplementedError(
+                "write() takes raw float rows; quantized pools are written "
+                "by the compiled loops via quantize_kv_lanes")
         s = new_k.shape[1]
         pos = start_pos + jnp.arange(s)
         blk = block_ids[pos // self.block_size]       # (S,) physical block
@@ -139,9 +199,13 @@ class BlockedKVCache:
         def scatter_pages(kpool, vpool, ids, kp, vp):
             """Write page payloads back into the (donated) pools at
             ``ids`` (swap-in restore). Pad ids are 0: garbage lands in the
-            trash block, which is never read as live content."""
-            return (kpool.at[:, :, ids].set(kp.astype(kpool.dtype)),
-                    vpool.at[:, :, ids].set(vp.astype(vpool.dtype)))
+            trash block, which is never read as live content. Payload
+            dtype must already match the pool — the host wrapper rejects
+            mixed-dtype moves loudly (a blind astype here would turn an
+            f32-era tier record restored into an int8 pool into silently
+            corrupted scale lanes)."""
+            return (kpool.at[:, :, ids].set(kp),
+                    vpool.at[:, :, ids].set(vp))
         return scatter_pages
 
     def _pad_ids(self, ids: List[int], pad: int = 0) -> jnp.ndarray:
@@ -182,7 +246,21 @@ class BlockedKVCache:
         """Swap-in restore: scatter host page payloads into the (donated)
         pools at ``block_ids``; returns the updated pools — rebind them.
         Under tensor parallelism the update is placed with the pools'
-        sharding first, so the scatter stays shard-local."""
+        sharding first, so the scatter stays shard-local.
+
+        Mixed-dtype moves fail loudly: restoring a record written by a
+        differently-typed pool (e.g. an f32-era tier record into an int8
+        pool) would either corrupt packed scale lanes or reinterpret int8
+        bytes as floats. Tier records carry a versioned layout field
+        (``kv_hierarchy``) precisely so this surfaces as an error at the
+        boundary, never as silent coercion."""
+        for nm, pages, pool in (("k", k_pages, kpool), ("v", v_pages, vpool)):
+            if np.dtype(pages.dtype) != np.dtype(pool.dtype):
+                raise ValueError(
+                    f"scatter_pages: {nm}-page payload dtype {pages.dtype} "
+                    f"!= pool dtype {pool.dtype} — refusing the mixed-dtype "
+                    "move (stale tier record from a differently-quantized "
+                    "pool?); re-ingest the sequence instead")
         ids = self._pad_ids(block_ids)
         w = int(ids.shape[0])
         n = len(block_ids)
@@ -199,7 +277,8 @@ class BlockedKVCache:
     def gather(self, block_table: jnp.ndarray):
         """block_table: (B, max_blocks) → (L, B, max_blocks*block_size, KVH, D)
         contiguous logical view (padding blocks read block 0 — callers mask
-        by sequence length)."""
+        by sequence length). Quantized pools return PACKED rows (D + scale
+        lanes) — dequantize with ``dequantize_kv_lanes``."""
         k = jnp.take(self.k, block_table, axis=2)      # (L, KVH, B, max_blocks, bs, D)
         v = jnp.take(self.v, block_table, axis=2)
         l, kvh, b, nb, bs, d = k.shape

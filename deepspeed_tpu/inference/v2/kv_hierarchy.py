@@ -585,7 +585,22 @@ class KVSwapTier:
 
     @staticmethod
     def _page_shape(kv, n: int) -> Tuple[int, ...]:
-        return (kv.num_layers, kv.kv_heads, n, kv.block_size, kv.head_dim)
+        # kv.lanes is the pool row width: head_dim, or head_dim + packed
+        # scale lanes for int8 pools — tier records ship the quantized
+        # representation verbatim, so the on-disk geometry follows it
+        return (kv.num_layers, kv.kv_heads, n, kv.block_size, kv.lanes)
+
+    @staticmethod
+    def _pool_layout(kv) -> str:
+        """Versioned page-row layout tag stored in every tier record.
+        ``raw`` = plain dtype rows; ``int8_scale_lanes_v1`` = absmax int8
+        values + bitcast f32 scale in trailing lanes
+        (``kv_cache.quantize_kv_lanes``). Restores refuse records whose
+        layout differs from the pool's — same-byte-width pools with
+        different row semantics (or an f32-era record meeting a quantized
+        pool) must fail loudly, never silently reinterpret scale bytes."""
+        return "int8_scale_lanes_v1" if getattr(kv, "quantized", False) \
+            else "raw"
 
     def _adopt(self, key: str, kv, n: int) -> None:
         """Register swapper metadata for a key written by a previous tier
@@ -608,6 +623,7 @@ class KVSwapTier:
             self.swapper.swap_out(f"{prefix}_dv", dvp, async_op=True)
         rec = {"blocks": n, "draft": draft_kv is not None,
                "dtype": str(kv.k.dtype),
+               "layout": self._pool_layout(kv),
                "page_shape": list(self._page_shape(kv, n))}
         if draft_kv is not None:
             rec["draft_shape"] = list(self._page_shape(draft_kv, n))
@@ -638,6 +654,13 @@ class KVSwapTier:
         if rec["dtype"] != str(kv.k.dtype):
             raise IOError(f"{prefix}: pages were swapped as {rec['dtype']} "
                           f"but the pool is {kv.k.dtype}")
+        # records from before the layout field are pre-quantization "raw"
+        if rec.get("layout", "raw") != self._pool_layout(kv):
+            raise IOError(
+                f"{prefix}: pages were swapped with row layout "
+                f"{rec.get('layout', 'raw')!r} but the pool expects "
+                f"{self._pool_layout(kv)!r} (engine kv_dtype changed since "
+                "the record was written)")
         n = rec["blocks"]
         if len(dst_blocks) != n:
             raise IOError(f"{prefix}: {n} pages recorded, "
@@ -930,6 +953,12 @@ class KVSwapTier:
         if rec["dtype"] != str(kv.k.dtype):
             raise IOError(f"{key}: pages were swapped as {rec['dtype']} "
                           f"but the pool is {kv.k.dtype}")
+        if rec.get("layout", "raw") != self._pool_layout(kv):
+            raise IOError(
+                f"{key}: pages were swapped with row layout "
+                f"{rec.get('layout', 'raw')!r} but the pool expects "
+                f"{self._pool_layout(kv)!r} (engine kv_dtype changed since "
+                "the record was written)")
         if tuple(rec.get("page_shape", ())) != \
                 self._page_shape(kv, rec["blocks"]):
             raise IOError(

@@ -1,0 +1,141 @@
+"""Serving-side weight quantization: int8 per-channel storage for the big
+matmuls, dequantized in-graph at use.
+
+Analog of the reference's weight-only quantization for inference
+(ZeRO-Inference / DeepSpeed-Inference, arXiv 2207.00032): the capacity win
+comes from the RESIDENT representation — each targeted weight leaf is
+replaced by ``{"q": int8, "s": f32}`` with one absmax scale per OUTPUT
+channel (the reduced/contracted axes collapse to keepdims size-1 dims), and
+``models.layers.dq`` rebuilds the float operand as a fused cast inside the
+matmul read. Quantizing per output channel keeps the matmul's accumulated
+error down to one rounding step of the inputs' column — the standard W8
+contract the parity tests bound at <=5% logit error.
+
+What gets quantized (and along which contraction):
+
+====================  ==========================  =====================
+leaf                  logical axes                contracted (reduced)
+====================  ==========================  =====================
+attn wq/wk/wv         (embed, heads|kvh, hd)      embed
+attn wo               (heads, head_dim, embed)    heads, head_dim
+mlp wi/wi_gate/wi_up  (embed, mlp)                embed
+mlp wo                (mlp, embed)                mlp
+embed lm_head         (embed, vocab)              embed
+====================  ==========================  =====================
+
+Everything else — embeddings, norms, biases, QK norms, tied lm_head (it IS
+the embedding), and every MoE expert stack (detected by the ``router`` key;
+expert matmuls run through ``apply_moe_mlp``, which has no dequant hook) —
+stays in the checkpoint dtype. Contracted positions are located by NAME in
+the model's ``logical_axes()`` tree, so the stacked leading "layers" axis
+(and any other non-contracted axis) keeps per-slice scales automatically.
+
+Tensor-parallel composition: ``quantize_params`` transforms the param tree
+and its PartitionSpec tree JOINTLY — ``q`` inherits the weight's spec
+unchanged (int8 shards exactly like the float leaf it replaces), and ``s``
+takes the same spec with the contracted entries nulled (a keepdims size-1
+dim cannot be split), so column/row sharding and the scale placement can
+never disagree.
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+# (parent key, leaf key) -> logical axis names reduced by the matmul
+_CONTRACTED = {
+    ("attn", "wq"): ("embed",),
+    ("attn", "wk"): ("embed",),
+    ("attn", "wv"): ("embed",),
+    ("attn", "wo"): ("heads", "head_dim"),
+    ("mlp", "wi"): ("embed",),
+    ("mlp", "wi_gate"): ("embed",),
+    ("mlp", "wi_up"): ("embed",),
+    ("mlp", "wo"): ("mlp",),
+    ("embed", "lm_head"): ("embed",),
+}
+
+
+def _quantize_leaf(w, red_dims: Tuple[int, ...]):
+    """Symmetric absmax int8 over ``red_dims`` (keepdims): one scale per
+    output channel. All-zero channels get scale 0 and dequantize to 0."""
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=red_dims,
+                   keepdims=True)
+    s = amax / 127.0
+    q = jnp.where(s > 0, jnp.round(w.astype(jnp.float32)
+                                   / jnp.where(s > 0, s, 1.0)), 0)
+    return {"q": jnp.clip(q, -127, 127).astype(jnp.int8),
+            "s": s.astype(jnp.float32)}
+
+
+def _scale_spec(spec, ndim: int, red_dims: Tuple[int, ...]):
+    """The scale's PartitionSpec: the weight's, with contracted (now
+    size-1) entries set to None — sharding a keepdims dim would fail the
+    divisibility check for nothing."""
+    entries = list(spec) + [None] * (ndim - len(spec))
+    for i in red_dims:
+        entries[i] = None
+    return P(*entries)
+
+
+def quantize_params(params, logical_axes, specs=None,
+                    weight_dtype: str = "int8") -> Tuple[Any, Optional[Any]]:
+    """Quantize the serving param tree (and, when given, its spec tree).
+
+    ``logical_axes``: the model's ``logical_axes()`` tree (mirrors params;
+    leaves are tuples of axis names). ``specs``: the ``inference_tp_specs``
+    PartitionSpec tree for sharded serving, or None at tp=1. Returns
+    ``(qparams, qspecs)`` with ``qspecs`` None iff ``specs`` was None.
+    """
+    if weight_dtype != "int8":
+        raise ValueError(
+            f"weight_dtype must be 'int8', got {weight_dtype!r} "
+            "(fp8 is a collective wire format, not a storage format — "
+            "see tp_collective_payload)")
+
+    def walk(p, ax, sp, parent):
+        if isinstance(p, dict):
+            if "router" in p:   # MoE expert stack: no dequant hook, skip
+                return p, sp
+            out_p = {}
+            out_s = {} if sp is not None else None
+            for k, v in p.items():
+                if isinstance(v, dict):
+                    rp, rs = walk(v, ax[k], None if sp is None else sp[k], k)
+                else:
+                    rp, rs = leaf(v, ax[k], None if sp is None else sp[k],
+                                  parent, k)
+                out_p[k] = rp
+                if sp is not None:
+                    out_s[k] = rs
+            return out_p, out_s
+        return p, sp
+
+    def leaf(w, ax, sp, parent, name):
+        names = _CONTRACTED.get((parent, name))
+        if names is None:
+            return w, sp
+        red = tuple(i for i, a in enumerate(ax) if a in names)
+        if not red or len(red) != len(names):
+            return w, sp          # unexpected layout: leave untouched
+        qw = _quantize_leaf(w, red)
+        if sp is None:
+            return qw, None
+        return qw, {"q": sp, "s": _scale_spec(sp, w.ndim, red)}
+
+    qparams, qspecs = walk(params, logical_axes, specs, "")
+    return qparams, qspecs
+
+
+def quantized_param_bytes(params) -> Tuple[int, int]:
+    """(bytes_quantized_leaves, bytes_total) of the resident tree — the
+    observability hook benches report the weight-side saving from."""
+    q_bytes = total = 0
+    for leaf_ in jax.tree.leaves(params):
+        b = leaf_.size * leaf_.dtype.itemsize
+        total += b
+        if leaf_.dtype == jnp.int8:
+            q_bytes += b
+    return q_bytes, total

@@ -29,6 +29,7 @@ from ...models import layers as L
 from ...models.transformer import CausalLM
 from ...ops.attention import decode_attention
 from ..sampling import sample_logits_per_row, speculative_verify_per_row
+from .kv_cache import dequantize_kv_lanes, quantize_kv_lanes
 from .telemetry import N_STATS   # in-graph frame-counter vector layout
 
 
@@ -156,9 +157,12 @@ class PagedModelRunner:
                 from ...compression.compress import fake_quantize_activation
                 h = fake_quantize_activation(h, cfg.act_quant_bits)
             a_in = L.apply_norm(lp["norm1"], h, cfg)
-            q = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wq"].astype(dt))
-            k = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wk"].astype(dt))
-            v = jnp.einsum("bse,ehd->bshd", a_in, lp["attn"]["wv"].astype(dt))
+            # L.dq dequantizes int8 per-channel weight leaves in-graph (a
+            # cast, like .astype for unquantized leaves — XLA fuses it into
+            # the einsum read, so the resident copy stays int8)
+            q = jnp.einsum("bse,ehd->bshd", a_in, L.dq(lp["attn"]["wq"], dt))
+            k = jnp.einsum("bse,ehd->bshd", a_in, L.dq(lp["attn"]["wk"], dt))
+            v = jnp.einsum("bse,ehd->bshd", a_in, L.dq(lp["attn"]["wv"], dt))
             if cfg.use_bias or cfg.qkv_bias:
                 q = q + L.bcast(lp["attn"]["bq"].astype(dt), q.ndim)
                 k = k + L.bcast(lp["attn"]["bk"].astype(dt), k.ndim)
@@ -179,7 +183,11 @@ class PagedModelRunner:
             # xs/ys restacks the pools every step, and scattering into a
             # carried full pool makes XLA copy it defensively around the
             # kernel's read.)
-            if _use_pallas_paged():
+            # int8 pools carry packed scale-lane rows the Pallas kernel
+            # doesn't decode — quantized KV takes the gather path, where
+            # the page rows are unpacked right after the gather
+            quantized_kv = kpool.dtype == jnp.int8
+            if _use_pallas_paged() and not quantized_kv:
                 # decode AND chunked prefill read pages in place (no
                 # gather); causal masking, sliding windows (uniform or
                 # per-layer traced), ALiBi, and attention softcapping all
@@ -192,14 +200,20 @@ class PagedModelRunner:
                     softcap=cfg.attn_softcap)
             else:
                 kvh_loc = kpool.shape[1]   # local KV heads (KVH/tp under tp)
+                lanes = kpool.shape[-1]    # D, or D + scale lanes when int8
                 kl = jnp.take(kpool, l, axis=0)   # escape hatch: copies 1/L
                 vl = jnp.take(vpool, l, axis=0)
                 kpages = kl[:, block_tables].reshape(
-                    kvh_loc, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
+                    kvh_loc, b, -1, lanes).transpose(1, 2, 0, 3)
                 vpages = vl[:, block_tables].reshape(
-                    kvh_loc, b, -1, cfg.dims_per_head).transpose(1, 2, 0, 3)
+                    kvh_loc, b, -1, lanes).transpose(1, 2, 0, 3)
+                if quantized_kv:
+                    kpages = dequantize_kv_lanes(kpages, dt)
+                    vpages = dequantize_kv_lanes(vpages, dt)
                 # per-query causal mask via positions: query at position p
-                # sees cache slots [0, p]; masks by slot index.
+                # sees cache slots [0, p]; masks by slot index. The chunk's
+                # own k/v ride in raw (pre-quantization) — only pool pages
+                # pay the quantize/dequantize round-trip.
                 out = _paged_attention(q, kpages, vpages, positions, cfg,
                                        window=win, chunk_k=k, chunk_v=v,
                                        chunk_start=chunk_start,
@@ -207,7 +221,7 @@ class PagedModelRunner:
             # row-parallel output projection: under tp the per-shard product
             # covers only the local heads — all-reduce BEFORE the replicated
             # bias, so the bias is added exactly once
-            y = jnp.einsum("bshd,hde->bse", out, lp["attn"]["wo"].astype(dt))
+            y = jnp.einsum("bshd,hde->bse", out, L.dq(lp["attn"]["wo"], dt))
             if tp is not None:
                 y = tp.coll.psum_attn(y)
             if "bo" in lp["attn"]:   # presence-keyed: out_bias may differ from use_bias
@@ -228,6 +242,11 @@ class PagedModelRunner:
             if cfg.sandwich_norm:
                 mlp_out = L.apply_norm(lp["norm4"], mlp_out, cfg)
             h = h + y + mlp_out if cfg.parallel_block else h + mlp_out
+            # quantize-at-append: the chunk's KV leaves the layer already in
+            # pool representation, so the commit scatter in _run_layers is
+            # dtype-blind and the pool never holds a float row
+            if quantized_kv:
+                return h, (quantize_kv_lanes(k), quantize_kv_lanes(v))
             return h, (k.astype(kpool.dtype), v.astype(vpool.dtype))
 
         h, kpool, vpool = self._run_layers(layer, h, params, kpool, vpool,
@@ -287,7 +306,8 @@ class PagedModelRunner:
         if cfg.tie_embeddings:
             logits = jnp.einsum(eq_tied, h_last, params["embed"]["tok"].astype(dt))
         else:
-            logits = jnp.einsum(eq_untied, h_last, params["embed"]["lm_head"].astype(dt))
+            logits = jnp.einsum(eq_untied, h_last,
+                                L.dq(params["embed"]["lm_head"], dt))
         if "lm_head_bias" in params["embed"]:
             logits = logits + L.bcast(
                 params["embed"]["lm_head_bias"].astype(logits.dtype),

@@ -260,6 +260,7 @@ class ServingTelemetry:
     def reset(self) -> None:
         """Zero every counter, histogram, and open span (new serve() run)."""
         self._gamma = 0
+        self._kv_block_bytes = 0
         self.counters: Dict[str, int] = {n: 0 for n in STAT_NAMES}
         self.counters.update(requests_enqueued=0, requests_admitted=0,
                              requests_retired=0, admission_deferrals=0,
@@ -283,6 +284,13 @@ class ServingTelemetry:
                              prefix_blocks_swapped_in=0,
                              kv_swap_out_requests=0, kv_swap_out_blocks=0,
                              kv_swap_in_requests=0, kv_swap_in_blocks=0,
+                             # bytes moved over the swap tier in EITHER
+                             # direction, at the pool's resident
+                             # representation (quantized pools move their
+                             # int8+scale pages, so an int8 engine's swap
+                             # traffic reads ~2.7x smaller than f32 for
+                             # the same block counts)
+                             kv_swap_bytes=0,
                              kv_swap_resume_restores=0,
                              # disaggregated prefill/decode fleet
                              # (router.py roles): requests handed off to a
@@ -300,7 +308,7 @@ class ServingTelemetry:
         self.gauges: Dict[str, float] = {
             "live_slots": 0, "slot_count": 0, "queue_depth": 0,
             "kv_blocks_in_use": 0, "kv_blocks_in_use_peak": 0,
-            "kv_blocks_total": 0,
+            "kv_blocks_total": 0, "kv_resident_bytes": 0,
             "occupancy": 0.0, "recompiled_programs": 0,
             "slo_risk": 0.0, "frame_steps_chosen": 0,
             "last_recovery_ms": 0.0, "tp_degree": 1,
@@ -338,10 +346,16 @@ class ServingTelemetry:
 
     def begin_serve(self, *, speculate: bool, gamma: int, adaptive: bool,
                     n_slots: int, kv_blocks_total: int,
-                    tp_degree: int = 1) -> None:
-        """Called by ``serve()`` at generator construction."""
+                    tp_degree: int = 1, kv_block_bytes: int = 0) -> None:
+        """Called by ``serve()`` at generator construction.
+        ``kv_block_bytes`` is the pool-resident footprint of one KV block
+        across all layers (``BlockedKVCache.block_bytes``) — the
+        multiplier that turns block counts into the byte-denominated
+        swap/residency series (``ds_serving_kv_swap_bytes_total``,
+        ``ds_serving_kv_resident_bytes``)."""
         self.reset()
         self._gamma = gamma if speculate else 0
+        self._kv_block_bytes = kv_block_bytes
         self.serve_view["adaptive_frame_steps"] = adaptive
         self.serve_view["spec"]["gamma"] = self._gamma
         self.gauges["slot_count"] = n_slots
@@ -666,6 +680,7 @@ class ServingTelemetry:
             return
         self.counters["kv_swap_out_requests"] += 1
         self.counters["kv_swap_out_blocks"] += n_blocks
+        self.counters["kv_swap_bytes"] += n_blocks * self._kv_block_bytes
         if uid is not None:
             self._trace_span(self._open_spans.get(uid),
                              "tier.publish" if publish else "kv.swap_out",
@@ -681,6 +696,7 @@ class ServingTelemetry:
             return
         self.counters["kv_swap_in_requests"] += 1
         self.counters["kv_swap_in_blocks"] += n_blocks
+        self.counters["kv_swap_bytes"] += n_blocks * self._kv_block_bytes
         if resume:
             self.counters["kv_swap_resume_restores"] += 1
         if uid is not None:
@@ -839,6 +855,11 @@ class ServingTelemetry:
             int(self.gauges["slot_count"]) * steps
         self.gauges["live_slots"] = live_slots
         self.gauges["kv_blocks_in_use"] = kv_blocks_in_use
+        # byte-denominated residency: block counts x the pool-resident
+        # block footprint, so an int8-KV engine's HBM pressure reads
+        # directly against an f32 engine's on the same dashboard panel
+        self.gauges["kv_resident_bytes"] = \
+            kv_blocks_in_use * self._kv_block_bytes
         # instantaneous gauges go stale on the drain frames at the end of a
         # run — the peak is the run-level KV-pressure figure
         self.gauges["kv_blocks_in_use_peak"] = max(

@@ -77,7 +77,8 @@ class TPContext:
 
 
 def build_tp_context(model, tp: int, *, quantized: bool = False,
-                     overlap: bool = False, role: str = "target",
+                     overlap: bool = False, payload: str = "int8",
+                     role: str = "target",
                      mesh: Optional[Mesh] = None) -> Optional[TPContext]:
     """Build the serving TP context for ``model`` (a ``CausalLM``).
 
@@ -107,5 +108,6 @@ def build_tp_context(model, tp: int, *, quantized: bool = False,
                                vocab_sharded=vocab_sharded)
     return TPContext(mesh=mesh, degree=tp,
                      coll=TPCollectives(axis=TP_AXIS, degree=tp,
-                                        quantized=quantized, overlap=overlap),
+                                        quantized=quantized, overlap=overlap,
+                                        payload=payload),
                      vocab_sharded=vocab_sharded, param_specs=specs)

@@ -37,6 +37,21 @@ def bcast(w, ndim: int):
     return w.reshape((1,) * (ndim - w.ndim) + w.shape)
 
 
+def dq(w, dt):
+    """Dequantize-or-cast a weight leaf to compute dtype ``dt``.
+
+    Serving-side weight quantization (``inference/v2/model_implementations/
+    quantize.py``) replaces a matmul weight leaf with ``{"q": int8,
+    "s": f32 keepdims-scale}``; everything else stays a plain array. The
+    structure check is a static (trace-time) decision, so unquantized
+    models trace the exact pre-quantization program, and the dequantized
+    product broadcasts the per-output-channel scale back over the reduced
+    axes (keepdims size-1 dims)."""
+    if isinstance(w, dict) and "q" in w:
+        return w["q"].astype(dt) * w["s"].astype(dt)
+    return w.astype(dt)
+
+
 # ---- norms --------------------------------------------------------------
 
 def init_norm(cfg: TransformerConfig):
@@ -311,20 +326,20 @@ def apply_mlp(params, x, cfg: TransformerConfig, reduce=None):
     dt = cfg.act_dtype
     mlp_bias = cfg.use_bias if cfg.mlp_bias is None else cfg.mlp_bias
     if cfg.activation in ("swiglu", "geglu"):
-        g = jnp.einsum("bse,ef->bsf", x, params["wi_gate"].astype(dt))
-        u = jnp.einsum("bse,ef->bsf", x, params["wi_up"].astype(dt))
+        g = jnp.einsum("bse,ef->bsf", x, dq(params["wi_gate"], dt))
+        u = jnp.einsum("bse,ef->bsf", x, dq(params["wi_up"], dt))
         gate = (jax.nn.gelu(g, approximate=True) if cfg.activation == "geglu"
                 else jax.nn.silu(g))
         h = gate * u
     else:
-        h = jnp.einsum("bse,ef->bsf", x, params["wi"].astype(dt))
+        h = jnp.einsum("bse,ef->bsf", x, dq(params["wi"], dt))
         if mlp_bias:
             h = h + bcast(params["bi"].astype(dt), h.ndim)
         if cfg.activation == "relu":
             h = jax.nn.relu(h)
         else:  # "gelu" = tanh approximation (gelu_new); "gelu_exact" = erf
             h = jax.nn.gelu(h, approximate=cfg.activation != "gelu_exact")
-    y = jnp.einsum("bsf,fe->bse", h, params["wo"].astype(dt))
+    y = jnp.einsum("bsf,fe->bse", h, dq(params["wo"], dt))
     if reduce is not None:
         y = reduce(y)
     if mlp_bias:

@@ -19,9 +19,15 @@ interchangeable lowerings per collective, picked by ``TPCollectives`` flags:
   hardware, approximated at the scheduling level. Summation order differs
   from ``psum`` (ring order), so this path is parity-at-tolerance, not
   bit-exact.
-- **quantized** (EQuARX, arXiv 2506.17615) — int8 payloads with per-row
-  f32 scales for the activation all-reduces and the logit all-gather:
-  2-4x less inter-chip traffic per step in exchange for bounded error.
+- **quantized** (EQuARX, arXiv 2506.17615) — low-precision payloads with
+  per-row f32 scales for the activation all-reduces, the masked embedding
+  psum, and the logit all-gather: 2-4x less inter-chip traffic per step in
+  exchange for bounded error. Two wire formats, picked by
+  ``TPCollectives.payload``: ``"int8"`` (default, symmetric round-to-
+  nearest, amax/127) and ``"fp8"`` (e4m3 per Big-Send-off-style scaled
+  casts, amax/448 — same byte width as int8 but a wider dynamic range
+  within each scaled row, trading one mantissa bit of uniform precision
+  for graceful handling of heavy-tailed activations).
   The all-reduce is a quantized reduce-scatter (``all_to_all`` of int8
   chunks + scales, dequantize-accumulate locally) followed by an int8
   all-gather of the reduced chunks — wire bytes 2(N-1)/N x 1 byte per
@@ -100,8 +106,10 @@ def psum_ring(x, axis: str, degree: int):
 
 
 # ---------------------------------------------------------------------------
-# quantized path: int8 payloads + per-row f32 scales (EQuARX-style)
+# quantized path: int8/fp8 payloads + per-row f32 scales (EQuARX-style)
 # ---------------------------------------------------------------------------
+
+_FP8_MAX = 448.0  # float8_e4m3fn largest finite value
 
 
 def _quantize_int8(x):
@@ -115,40 +123,64 @@ def _quantize_int8(x):
     return jnp.clip(q, -127, 127).astype(jnp.int8), scale
 
 
-def psum_quantized(x, axis: str, degree: int):
-    """All-reduce with int8 payloads, reduce-scatter shaped so the wire
+def _quantize_fp8(x):
+    """Per-row scaled cast to e4m3: same one byte per element on the wire
+    as int8, but the scaled row spans e4m3's full dynamic range instead of
+    a uniform grid. The clip pins the row amax to the largest finite e4m3
+    value so the cast can never produce inf/NaN."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = amax / _FP8_MAX
+    q = jnp.where(scale > 0, x.astype(jnp.float32)
+                  / jnp.where(scale > 0, scale, 1.0), 0.0)
+    q = jnp.clip(q, -_FP8_MAX, _FP8_MAX).astype(jnp.float8_e4m3fn)
+    return q, scale
+
+
+def _quantize(x, payload: str):
+    """Dispatch on the wire format. ``payload`` is a static string baked
+    into the traced program — GL202 sees either int8 or float8_e4m3fn
+    collective operands, never both."""
+    if payload == "fp8":
+        return _quantize_fp8(x)
+    return _quantize_int8(x)
+
+
+def psum_quantized(x, axis: str, degree: int, payload: str = "int8"):
+    """All-reduce with one-byte payloads, reduce-scatter shaped so the wire
     bytes actually shrink: chunk the last dim ``degree`` ways, quantize
-    each chunk with its own per-row scale, ``all_to_all`` the int8 chunks
-    (shard r receives every shard's chunk r — (N-1)/N x 1 byte/element),
-    dequantize-accumulate locally in f32, then requantize the reduced
-    chunk once and all-gather it back around ((N-1)/N x 1 byte/element
-    again). Total int8 wire: 2(N-1)/N bytes per element — the same ring
-    schedule as an exact all-reduce at a quarter the width, which is the
-    EQuARX claim graft-cost GL202 checks against the exact program.
+    each chunk with its own per-row scale, ``all_to_all`` the quantized
+    chunks (shard r receives every shard's chunk r — (N-1)/N x 1
+    byte/element), dequantize-accumulate locally in f32, then requantize
+    the reduced chunk once and all-gather it back around ((N-1)/N x 1
+    byte/element again). Total quantized wire: 2(N-1)/N bytes per element
+    — the same ring schedule as an exact all-reduce at a quarter the
+    width, which is the EQuARX claim graft-cost GL202 checks against the
+    exact program. ``payload`` picks int8 or fp8-e4m3 chunks (see
+    ``_quantize``); both are one byte on the wire.
 
     Error: each contribution is quantized once (finer per-chunk scales
     than whole-row) plus one requantization of the reduced chunk.
 
-    Falls back to a gather-based int8 exchange when the last dim doesn't
-    chunk evenly (tiny tensors aren't worth scattering)."""
+    Falls back to a gather-based quantized exchange when the last dim
+    doesn't chunk evenly (tiny tensors aren't worth scattering)."""
     if degree == 1:
         return x
     d = x.shape[-1]
     if d % degree != 0:
-        q, s = _quantize_int8(x)
+        q, s = _quantize(x, payload)
         qg = jax.lax.all_gather(q, axis)               # (degree, ...)
         sg = jax.lax.all_gather(s, axis)
         return jnp.sum(qg.astype(jnp.float32) * sg, axis=0).astype(x.dtype)
     shard = d // degree
     chunks = x.reshape(x.shape[:-1] + (degree, shard))
-    q, s = _quantize_int8(chunks)                      # s: (..., degree, 1)
+    q, s = _quantize(chunks, payload)                  # s: (..., degree, 1)
     ca = x.ndim - 1                                    # the chunk axis
     qx = jax.lax.all_to_all(q, axis, split_axis=ca, concat_axis=ca,
                             tiled=True)
     sx = jax.lax.all_to_all(s, axis, split_axis=ca, concat_axis=ca,
                             tiled=True)
     red = jnp.sum(qx.astype(jnp.float32) * sx, axis=-2)   # (..., shard)
-    q2, s2 = _quantize_int8(red)
+    q2, s2 = _quantize(red, payload)
     qg = jax.lax.all_gather(q2, axis, axis=x.ndim - 1, tiled=True)
     sg = jax.lax.all_gather(s2, axis, axis=x.ndim - 1, tiled=True)
     deq = (qg.reshape(qg.shape[:-1] + (degree, shard)).astype(jnp.float32)
@@ -156,12 +188,12 @@ def psum_quantized(x, axis: str, degree: int):
     return deq.reshape(x.shape[:-1] + (d,)).astype(x.dtype)
 
 
-def all_gather_quantized(x, axis: str, degree: int):
-    """Tiled all-gather of the LAST dim with int8 payloads (the per-step
-    logit exchange of a vocab-sharded LM head)."""
+def all_gather_quantized(x, axis: str, degree: int, payload: str = "int8"):
+    """Tiled all-gather of the LAST dim with one-byte payloads (the
+    per-step logit exchange of a vocab-sharded LM head)."""
     if degree == 1:
         return x
-    q, s = _quantize_int8(x)                           # s: (..., 1)
+    q, s = _quantize(x, payload)                       # s: (..., 1)
     qg = jax.lax.all_gather(q, axis, axis=q.ndim - 1, tiled=True)
     sg = jax.lax.all_gather(s, axis, axis=s.ndim - 1, tiled=True)  # (..., tp)
     shard = x.shape[-1]
@@ -179,23 +211,26 @@ def all_gather_quantized(x, axis: str, degree: int):
 class TPCollectives:
     """Per-engine choice of collective lowerings (see module docstring).
 
-    ``quantized`` switches the activation all-reduces AND the logit
-    all-gather to int8 payloads; ``overlap`` switches the MLP all-reduce
-    (the one with downstream-independent compute to hide behind, per T3)
-    to the chunked ring. ``quantized`` wins when both are set — the int8
-    exchange is already chunk-shaped."""
+    ``quantized`` switches the activation all-reduces, the masked
+    embedding psum, AND the logit all-gather to quantized payloads;
+    ``payload`` picks the wire format ("int8" default, "fp8" = e4m3);
+    ``overlap`` switches the MLP all-reduce (the one with downstream-
+    independent compute to hide behind, per T3) to the chunked ring.
+    ``quantized`` wins when both are set — the quantized exchange is
+    already chunk-shaped."""
 
     axis: str
     degree: int
     quantized: bool = False
     overlap: bool = False
+    payload: str = "int8"
 
     def psum_attn(self, x):
         """Attention-output (row-parallel wo) all-reduce."""
         if self.degree == 1:
             return x
         if self.quantized:
-            return psum_quantized(x, self.axis, self.degree)
+            return psum_quantized(x, self.axis, self.degree, self.payload)
         return psum_exact(x, self.axis)
 
     def psum_mlp(self, x):
@@ -203,17 +238,23 @@ class TPCollectives:
         if self.degree == 1:
             return x
         if self.quantized:
-            return psum_quantized(x, self.axis, self.degree)
+            return psum_quantized(x, self.axis, self.degree, self.payload)
         if self.overlap:
             return psum_ring(x, self.axis, self.degree)
         return psum_exact(x, self.axis)
 
     def psum_embed(self, x):
-        """Vocab-sharded embedding-lookup reduce: always exact — each token
-        row is nonzero on exactly one shard, so this psum is a select, and
-        quantizing it would spend error budget for no traffic win."""
+        """Vocab-sharded embedding-lookup reduce. Each token row is nonzero
+        on exactly one shard, so exact mode's psum is a select; under
+        ``quantized`` the rows ride the same one-byte exchange as the
+        activation all-reduces — the all-zero rows of non-owning shards
+        quantize to scale 0 and contribute exactly 0, so the only error is
+        one quantize/dequantize of the owning shard's real row, and the
+        per-step embedding traffic drops with everything else."""
         if self.degree == 1:
             return x
+        if self.quantized:
+            return psum_quantized(x, self.axis, self.degree, self.payload)
         return psum_exact(x, self.axis)
 
     def gather_logits(self, x):
@@ -221,5 +262,6 @@ class TPCollectives:
         if self.degree == 1:
             return x
         if self.quantized:
-            return all_gather_quantized(x, self.axis, self.degree)
+            return all_gather_quantized(x, self.axis, self.degree,
+                                        self.payload)
         return all_gather_exact(x, self.axis, gather_axis=-1)

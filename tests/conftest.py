@@ -35,7 +35,8 @@ SANITIZE = os.environ.get("GRAFT_SANITIZE", "0") == "1"
 SERVING_SUITES = ("test_frame_serving", "test_serving_telemetry",
                   "test_serving_scheduler", "test_serving_faults",
                   "test_serving_tp", "test_kv_hierarchy", "test_router",
-                  "test_disagg", "test_service", "test_tracing")
+                  "test_disagg", "test_service", "test_tracing",
+                  "test_quantized_serving")
 
 #: fault-injection suites intentionally produce NaN logits (poison rows):
 #: jax_debug_nans would abort the machinery under test

@@ -1,0 +1,268 @@
+"""Quantized serving: int8 weights, int8 paged KV, low-precision collectives.
+
+The low-precision serving configs (``RaggedInferenceEngineConfig.kv_dtype``
+/ ``weight_dtype`` / ``tp_collective_payload``) trade precision for HBM and
+wire bytes, and each trade ships with an explicit tolerance contract these
+tests pin:
+
+- **int8 KV pages** quantize at append with a per-(token, head) scale
+  packed into the page row (write-once, so a token's stored representation
+  never depends on when it is read): greedy serving is TOKEN-IDENTICAL to
+  the f32 engine — at tp=1, at tp=8, and with the prefix cache republishing
+  quantized pages.
+- **int8 weights** (per-output-channel absmax) bound the single-forward
+  logit error to <= 5% of the logit scale, and a teacher-forced perplexity
+  smoke stays within 10% of the f32 engine's — close in distribution, not
+  just argmax.
+- **fp8 (e4m3) collective payloads** ride the same quantized-exchange
+  machinery as int8 and must complete every generation budget.
+- The pool's resident representation is a CONTRACT across the memory
+  hierarchy: swap-tier records carry a versioned layout stamp and refuse
+  to restore into a differently-quantized pool, page movers refuse
+  mixed-dtype scatters, and the byte-denominated telemetry
+  (``kv_swap_bytes`` / ``kv_resident_bytes``) prices blocks at the
+  resident footprint — the >= 1.8x int8 page saving is asserted here.
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (InferenceEngineV2,
+                                                  RaggedInferenceEngineConfig)
+from deepspeed_tpu.inference.v2.kv_cache import BlockedKVCache
+from deepspeed_tpu.inference.v2.kv_hierarchy import KVSwapTier
+from deepspeed_tpu.models import build_model
+
+MAX_NEW = 8
+
+
+@pytest.fixture(scope="module")
+def model_params():
+    """8 heads so the SAME model serves the tp=1 contracts and the tp=8
+    parity leg (every sharded axis divides the 8-way mesh)."""
+    model = build_model("tiny", num_heads=8)
+    return model, model.init(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **over):
+    kw = dict(kv_block_size=16, prefill_chunk_size=16, max_tokens_per_step=256,
+              dtype="float32", max_ragged_batch_size=8, frame_steps=4,
+              frame_retry_backoff_s=0.0)
+    kw.update(over)
+    return InferenceEngineV2(model, RaggedInferenceEngineConfig(**kw),
+                             params=params, max_seq_len=128)
+
+
+PROMPTS = {u: np.random.default_rng(5).integers(0, 200, (200,))
+           .astype(np.int32)[o:o + n]
+           for u, (o, n) in enumerate(((0, 7), (10, 24), (40, 33), (80, 5)))}
+
+
+def _arrivals():
+    return iter([[(u, PROMPTS[u]) for u in PROMPTS]])
+
+
+@pytest.fixture(scope="module")
+def greedy_base(model_params):
+    """f32 tp=1 greedy serve() outputs — the reference every quantized
+    variant is measured against."""
+    model, params = model_params
+    return dict(_engine(model, params).serve(_arrivals(),
+                                             max_new_tokens=MAX_NEW))
+
+
+def _one_forward_logits(e, width=1):
+    """Single ragged forward through the engine's runner (tp=1): the
+    logit-tolerance surface, decoupled from sampling."""
+    ids = np.asarray([PROMPTS[1][:width]], np.int32)
+    pos = np.asarray([np.arange(width)], np.int32)
+    tbl = np.asarray([[1, 2]], np.int32)[:, :max(1, (width + 15) // 16)]
+    n = np.asarray([width], np.int32)
+    fwd = jax.jit(functools.partial(e.runner._forward, all_logits=True))
+    logits, _, _ = fwd(e.params, jnp.asarray(ids), jnp.asarray(pos),
+                       jnp.asarray(tbl), jnp.asarray(n), e.kv.k, e.kv.v)
+    return np.asarray(logits)
+
+
+# ---------------------------------------------------------------------------
+# int8 KV pages: exact greedy parity
+# ---------------------------------------------------------------------------
+
+def test_int8_kv_greedy_token_parity(model_params, greedy_base):
+    """int8 KV pages are write-once (scale packed beside the quantized
+    row), so greedy decoding is token-identical to the f32 pool — the
+    strongest contract a lossy representation can offer."""
+    model, params = model_params
+    e = _engine(model, params, kv_dtype="int8")
+    assert e.kv.k.dtype == jnp.int8
+    got = dict(e.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    for u in PROMPTS:
+        np.testing.assert_array_equal(greedy_base[u], got[u],
+                                      err_msg=f"uid={u} diverged")
+    assert e.kv.free_blocks == e.kv.num_blocks - 1
+    assert not e.state.seqs
+
+
+def test_int8_kv_prefix_cache_parity(model_params, greedy_base):
+    """The prefix cache publishes/restores QUANTIZED pages: a second pass
+    over the same prompts (served from cache hits) is still
+    token-identical to the f32 baseline."""
+    model, params = model_params
+    e = _engine(model, params, kv_dtype="int8", prefix_cache=True)
+    first = dict(e.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    second = dict(e.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    assert e.telemetry.counters["prefix_hits"] > 0, \
+        "second pass must actually hit the cache"
+    for u in PROMPTS:
+        np.testing.assert_array_equal(greedy_base[u], first[u],
+                                      err_msg=f"cache-cold uid={u}")
+        np.testing.assert_array_equal(greedy_base[u], second[u],
+                                      err_msg=f"cache-hot uid={u}")
+
+
+@pytest.mark.multichip
+def test_tp8_int8_kv_token_parity(model_params, greedy_base):
+    """Head-sharded int8 pools (scale lanes ride the head_dim axis, which
+    is unsharded) keep the tp=8 engine token-identical too."""
+    model, params = model_params
+    e = _engine(model, params, tp=8, kv_dtype="int8")
+    got = dict(e.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    for u in PROMPTS:
+        np.testing.assert_array_equal(greedy_base[u], got[u],
+                                      err_msg=f"uid={u} diverged")
+    assert e.kv.free_blocks == e.kv.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# int8 weights: bounded logit error, ppl smoke
+# ---------------------------------------------------------------------------
+
+def test_int8_weights_logit_error_within_5pct(model_params):
+    """Per-channel absmax int8 weights: one ragged forward's logits track
+    the f32 engine within 5% of the logit scale, and a full quantized
+    serve still completes every generation budget."""
+    model, params = model_params
+    ef = _engine(model, params)
+    eq = _engine(model, params, weight_dtype="int8")
+    exact = _one_forward_logits(ef)
+    quant = _one_forward_logits(eq)
+    scale = np.abs(exact).max()
+    assert np.abs(exact - quant).max() <= 0.05 * scale, \
+        (np.abs(exact - quant).max(), scale)
+    got = dict(eq.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    assert set(got) == set(PROMPTS)
+    assert all(len(v) == MAX_NEW for v in got.values())
+
+
+def test_full_quant_ppl_smoke(model_params):
+    """Teacher-forced perplexity over a real prompt: the fully quantized
+    engine (int8 weights + int8 KV) stays within 10% of the f32 engine's
+    ppl — the distribution-level smoke behind the argmax contracts."""
+    model, params = model_params
+    toks = PROMPTS[2][:16]
+
+    def ppl(e):
+        logits = _one_forward_logits(e, width=len(toks))[0]
+        logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        nll = -np.asarray(logp)[np.arange(len(toks) - 1), toks[1:]]
+        return float(np.exp(nll.mean()))
+
+    base = ppl(_engine(model, params))
+    quant = ppl(_engine(model, params, weight_dtype="int8",
+                        kv_dtype="int8"))
+    assert abs(quant - base) <= 0.10 * base, (base, quant)
+
+
+# ---------------------------------------------------------------------------
+# fp8 collective payloads
+# ---------------------------------------------------------------------------
+
+@pytest.mark.multichip
+def test_tp8_fp8_collectives_complete_budgets(model_params):
+    """The e4m3 payload variant of the quantized exchanges completes every
+    generation budget and drains clean (same contract shape as the int8
+    payload: near-ties may flip, budgets may not)."""
+    model, params = model_params
+    e = _engine(model, params, tp=8, tp_quantized_collectives=True,
+                tp_collective_payload="fp8")
+    got = dict(e.serve(_arrivals(), max_new_tokens=MAX_NEW))
+    assert set(got) == set(PROMPTS)
+    assert all(len(v) == MAX_NEW for v in got.values())
+    assert e.kv.free_blocks == e.kv.num_blocks - 1
+
+
+# ---------------------------------------------------------------------------
+# configuration and representation contracts
+# ---------------------------------------------------------------------------
+
+def test_quant_config_validation(model_params):
+    """Unsupported dtypes fail at CONSTRUCTION, not mid-serve."""
+    model, params = model_params
+    for bad in (dict(kv_dtype="int4"), dict(weight_dtype="fp4"),
+                dict(tp_collective_payload="int4")):
+        with pytest.raises(ValueError):
+            _engine(model, params, **bad)
+
+
+def _pool(kv_dtype=None):
+    kv = BlockedKVCache(num_layers=2, kv_heads=2, head_dim=4, num_blocks=8,
+                        block_size=4, dtype=jnp.float32, kv_dtype=kv_dtype)
+    kv.reserve_trash_block()
+    return kv
+
+
+def test_tier_layout_mismatch_fails_loudly(tmp_path):
+    """A tier record committed from an int8 pool restores bit-identically
+    into an int8 pool, and REFUSES (IOError, not a silent astype) to
+    restore into an f32 pool: the record's versioned layout stamp is
+    checked against the destination's resident representation."""
+    kv = _pool("int8")
+    blocks = kv.allocator.allocate(2)
+    payload = np.random.default_rng(3).integers(
+        -127, 127, (2, 2, 2, 4, kv.lanes)).astype(np.int8)
+    kv.k = kv.k.at[:, :, blocks].set(payload)
+    kv.v = kv.v.at[:, :, blocks].set(-payload)
+    tier = KVSwapTier(str(tmp_path))
+    tier.put_request(7, tokens=8, kv=kv, blocks=blocks)
+
+    dst = kv.allocator.allocate(2)
+    KVSwapTier(str(tmp_path)).restore_request(7, kv, dst)
+    np.testing.assert_array_equal(np.asarray(kv.k[:, :, dst]), payload)
+    np.testing.assert_array_equal(np.asarray(kv.v[:, :, dst]), -payload)
+
+    raw = _pool()
+    with pytest.raises(IOError, match="int8"):
+        tier.restore_request(7, raw, raw.allocator.allocate(2))
+
+
+def test_scatter_pages_dtype_mismatch_fails_loudly():
+    """Cross-pool page moves never coerce dtypes: an f32 page scattered
+    into an int8 pool (a stale mover wiring two differently-quantized
+    engines) raises instead of silently astype-ing garbage."""
+    src, dst = _pool(), _pool("int8")
+    pages_k, pages_v = src.read_pages([1])
+    with pytest.raises(ValueError, match="dtype"):
+        dst.scatter_pages(dst.k, dst.v, [1], pages_k, pages_v)
+
+
+def test_quantized_pool_block_bytes_and_telemetry(model_params):
+    """The resident block footprint drops >= 1.8x under int8 pages (the
+    GL201 carry-bytes claim, asserted at the pool), and the serve-time
+    telemetry prices blocks at that footprint: ``kv_resident_bytes`` and
+    ``kv_swap_bytes`` expose HBM/tier pressure in bytes, not blocks."""
+    model, params = model_params
+    ef = _engine(model, params)
+    eq = _engine(model, params, kv_dtype="int8")
+    ratio = ef.kv.block_bytes / eq.kv.block_bytes
+    assert ratio >= 1.8, ratio
+    dict(eq.serve(iter([[(0, PROMPTS[0])]]), max_new_tokens=MAX_NEW))
+    assert eq.telemetry._kv_block_bytes == eq.kv.block_bytes
+    assert "kv_resident_bytes" in eq.telemetry.gauges
+    assert "kv_swap_bytes" in eq.telemetry.counters
+    prom = eq.telemetry.render_prometheus()
+    assert "ds_serving_kv_swap_bytes_total" in prom
+    assert "ds_serving_kv_resident_bytes" in prom
