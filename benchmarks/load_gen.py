@@ -242,15 +242,23 @@ def run_open_loop(host: str, port: int, sched: Dict, rate: float) -> Dict:
 
     def fire(i: int, key) -> None:
         req = sched[key]
-        delay = start + i / max(rate, 1e-6) - time.monotonic()
+        sched_t = start + i / max(rate, 1e-6)   # INTENDED arrival
+        delay = sched_t - time.monotonic()
         if delay > 0:
             time.sleep(delay)
         body = {k: req[k] for k in ("prompt", "max_new_tokens",
                                     "tenant", "priority")}
         body["session"] = f"s{key[0]}"
+        send_t = time.monotonic()               # ACTUAL send
         status, out = sse_generate(host, port, body)
         with lock:
             if status == 200:
+                # stamp both times: schedule-relative latency charges the
+                # request from when it was SUPPOSED to arrive, so a lagging
+                # generator (thread wakeup under load) can't flatter the
+                # system by silently closing the loop
+                out["sched_t"] = sched_t
+                out["send_t"] = send_t
                 results[key] = out
             elif status == 429:
                 sheds["count"] += 1
@@ -268,9 +276,27 @@ def run_open_loop(host: str, port: int, sched: Dict, rate: float) -> Dict:
     elapsed = time.monotonic() - t0
     with lock:
         snap, fails, shed_snap = dict(results), list(failures), dict(sheds)
-    return _aggregate(snap, fails, shed_snap, elapsed,
-                      mode="open-loop", arrival_rate_per_s=rate,
-                      requests=len(order))
+    report = _aggregate(snap, fails, shed_snap, elapsed,
+                        mode="open-loop", arrival_rate_per_s=rate,
+                        requests=len(order))
+    # schedule-relative view: TTFT measured from the INTENDED arrival
+    # (sched_t), plus the generator's own lag (send_t - sched_t). If lag
+    # is material relative to the latencies reported, the run was
+    # generator-bound, not system-bound — sched_ttft_ms is the honest
+    # number either way, and the one the fleet simulator predicts.
+    lags = sorted(v["send_t"] - v["sched_t"] for v in snap.values())
+    sched_ttfts = sorted(v["send_t"] - v["sched_t"] + v["ttft_s"]
+                         for v in snap.values())
+
+    def pct(xs, p):
+        return round(float(np.percentile(xs, p)) * 1e3, 2) if xs else None
+
+    report["gen_lag_ms"] = {"p50": pct(lags, 50), "p90": pct(lags, 90),
+                            "max": pct(lags, 100)}
+    report["sched_ttft_ms"] = {"p50": pct(sched_ttfts, 50),
+                               "p90": pct(sched_ttfts, 90),
+                               "p99": pct(sched_ttfts, 99)}
+    return report
 
 
 # ----------------------------------------------------------------------

@@ -63,6 +63,8 @@ lowering variant), never by source position.
 import dataclasses
 import json
 import math
+import os
+import re
 from typing import Dict, List, Optional, Sequence
 
 from .findings import Finding
@@ -70,6 +72,10 @@ from .jaxpr_checks import (JAXPR_PATH, TracedProgram, _axis_names, _closed,
                            _trace_failure)
 
 COST_BASELINE_VERSION = 1
+#: the committed ledger at the repo root (three levels up from analysis/)
+COST_BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), ".graft-cost-baseline.json")
 #: relative drift per metric before GL201 fires. Static costs are exact —
 #: the tolerance only absorbs deliberate tiny-constant churn (a new stat
 #: lane, one more boundary flag), not real growth.
@@ -765,3 +771,93 @@ def render_cost_table(reports: List[CostReport]) -> str:
     return "\n".join(
         "| " + " | ".join(cell.ljust(w) for cell, w in zip(row, widths))
         + " |" for row in rows)
+
+
+# ----------------------------------------------------------------------
+# frame-cost QUERY API over a committed baseline (the simulator's
+# price list: sim/ replays traffic with frame costs read from HERE —
+# no frames executed)
+# ----------------------------------------------------------------------
+
+# metric keys every ledger entry carries (CostReport.metrics())
+COST_METRIC_KEYS = ("flops", "hbm_read", "hbm_write", "d2h_bytes",
+                    "collective_ops", "collective_payload",
+                    "collective_payload_int8")
+
+
+class FrameCostQuery:
+    """Query API over one committed ``.graft-cost-baseline.json``.
+
+    The ledger prices every traced serving program statically (GL201) —
+    this class makes it QUERYABLE by frame shape instead of by exact
+    program name: ``select(width=8, spec=True, tp=8, quant=True)``
+    resolves to ``frame_loop_spec[w=8][tp=8,quant]`` and returns its
+    FLOPs / HBM bytes / collective wire bytes. The trace-driven fleet
+    simulator prices every virtual frame through here; a kernel change
+    that shifts the ledger shifts the sim's capacity answers with it.
+    """
+
+    def __init__(self, baseline: Dict):
+        if baseline.get("version") != COST_BASELINE_VERSION:
+            raise ValueError(
+                f"cost baseline version {baseline.get('version')!r} != "
+                f"{COST_BASELINE_VERSION}")
+        self.programs: Dict[str, Dict] = baseline["programs"]
+        self._widths = sorted({
+            int(m.group(1)) for name in self.programs
+            for m in [re.search(r"\[w=(\d+)[,\]]", name)] if m})
+
+    @classmethod
+    def load(cls, path: str = COST_BASELINE_PATH) -> "FrameCostQuery":
+        return cls(load_cost_baseline(path))
+
+    def metrics(self, name: str) -> Dict[str, float]:
+        """Ledger metrics for one exact program name (KeyError with the
+        available names when absent — a renamed program must fail loudly,
+        not price frames at zero)."""
+        try:
+            return self.programs[name]
+        except KeyError:
+            raise KeyError(
+                f"program {name!r} not in the cost baseline; available: "
+                f"{sorted(self.programs)}") from None
+
+    def frame_program(self, *, width: int = 1, spec: bool = False,
+                      tp: int = 1, quant: bool = False, fp8: bool = False,
+                      ring: bool = False, repair: bool = False) -> str:
+        """Resolve a frame SHAPE to the ledger's program name.
+
+        ``width`` snaps to the nearest traced width bucket (the ledger
+        traces one narrow and one wide frame_loop; chunked-prefill frames
+        of any chunk size price from the wide bucket — the calibration
+        layer in ``sim.cost`` scales by the actual width). Exactly one of
+        the tp-variant flags (quant/fp8/ring) may be set with tp > 1."""
+        if not self._widths:
+            raise ValueError("cost baseline has no frame_loop[w=...] "
+                             "programs to price frames from")
+        w = min(self._widths, key=lambda b: (abs(b - width), b))
+        base = "frame_loop_spec" if spec else "frame_loop"
+        head = f"{base}[w={w},repair]" if repair else f"{base}[w={w}]"
+        if tp > 1:
+            variant = ("quant" if quant else "fp8" if fp8
+                       else "ring" if ring else None)
+            suffix = f"[tp={tp},{variant}]" if variant else f"[tp={tp}]"
+        else:
+            suffix = "[quant]" if quant else ""
+        name = head + suffix
+        if name not in self.programs and tp > 1:
+            # heterogeneous ledgers may trace one tp degree only — fall
+            # back to the traced tp suffix rather than KeyError on e.g.
+            # tp=4 when only tp=8 was traced (the calibration constants
+            # absorb the degree difference)
+            tail = f",{variant}]" if variant else "]"
+            cands = [n for n in self.programs
+                     if n.startswith(head + "[tp=") and n.endswith(tail)
+                     and (variant or "," not in n[len(head):])]
+            if cands:
+                name = sorted(cands)[0]
+        return name
+
+    def select(self, **shape) -> Dict[str, float]:
+        """``metrics(frame_program(**shape))`` — the one-call form."""
+        return self.metrics(self.frame_program(**shape))

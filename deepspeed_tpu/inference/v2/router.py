@@ -231,7 +231,8 @@ class EngineRouter:
     ``<layers>L-tp<degree>`` label)."""
 
     def __init__(self, engines, config: Optional[RouterConfig] = None,
-                 model_labels: Optional[Dict[str, str]] = None):
+                 model_labels: Optional[Dict[str, str]] = None,
+                 clock=None):
         self.cfg = config or RouterConfig()
         if not isinstance(engines, dict):
             engines = {f"engine{i}": e for i, e in enumerate(engines)}
@@ -331,7 +332,11 @@ class EngineRouter:
             name: 0 for name in self._replicas}
         self.last_recovery_ms: float = 0.0
         self._tick = 0               # current serve-loop tick (fault_log)
-        self._clock = time.monotonic
+        # injectable clock (ctor clock=): feeds the heartbeat gap
+        # measurement (step_t0 in _step vs the boundary's engine-clock t)
+        # and every trace/flight timestamp — the virtual-time seam the
+        # trace-driven simulator (sim/) steps the fleet on
+        self._clock = clock or time.monotonic
 
     # ------------------------------------------------------------------
     # fleet-wide observability (tracing.py)

@@ -199,8 +199,14 @@ class RequestScheduler:
     ``shed_log`` and summary counters survive across runs for inspection.
     """
 
-    def __init__(self, config: Optional[SchedulerConfig] = None):
+    def __init__(self, config: Optional[SchedulerConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.cfg = config or SchedulerConfig()
+        # injectable clock (sim/ virtual time): ShedReason.t is the only
+        # wall-clock read in the policy; None adopts the bound engine's
+        # clock at begin_serve (so a virtual-clocked engine stamps sheds
+        # in virtual time without the caller threading it twice)
+        self._clock: Optional[Callable[[], float]] = clock
         self.shed_log: deque = deque(maxlen=self.cfg.shed_log_max)
         self.summary: Dict = {
             "admitted_by_class": {n: 0 for n in PRIORITY_NAMES},
@@ -241,6 +247,8 @@ class RequestScheduler:
         self._reset_queues()
         self._blocks_for = engine.kv.blocks_for
         self._telemetry = engine.telemetry
+        if self._clock is None:
+            self._clock = getattr(engine, "_clock", None)
         if self.cfg.slo_ttft_ms is not None and not engine.telemetry.enabled:
             logger.warning(
                 "RequestScheduler: slo_ttft_ms is set but engine telemetry "
@@ -371,7 +379,7 @@ class RequestScheduler:
             priority=PRIORITY_NAMES[req.priority], reason=reason,
             risk=round(self.risk, 4), queue_depth=self.queued_count(),
             ttft_p90_ms=slo.get("ttft_p90_ms"), slo_ms=req.slo_ms,
-            t=time.monotonic())
+            t=(self._clock or time.monotonic)())
         self.shed_log.append(rec)
         self.summary["shed_by_class"][rec.priority] += 1
         return rec

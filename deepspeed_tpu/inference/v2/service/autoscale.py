@@ -36,7 +36,7 @@ Every action lands in ``events`` and the router's ``scale_up`` /
 """
 
 import dataclasses
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 from ....utils.logging import logger
 from ..router import HEALTHY
@@ -74,8 +74,15 @@ class AutoscaleConfig:
 class AutoscaleController:
     """See module docstring. One instance per ``FleetDriver``."""
 
-    def __init__(self, config: Optional[AutoscaleConfig] = None):
+    def __init__(self, config: Optional[AutoscaleConfig] = None,
+                 clock: Optional[Callable[[], float]] = None):
         self.cfg = config or AutoscaleConfig()
+        # injectable clock: the evaluation cadence and flip-dwell
+        # hysteresis are the controller's only wall-clock reads. None
+        # (the default) reads the driver's clock at on_tick, so the
+        # threaded fleet keeps time.monotonic and the trace-driven
+        # simulator (sim/) gets virtual time through either seam.
+        self._clock = clock
         self.events: List[Dict] = []
         self._flight = None                # router's FlightRecorder (if any)
         self._parked: List[str] = []       # names this controller drained
@@ -109,7 +116,7 @@ class AutoscaleController:
 
     def on_tick(self, driver, tick: int) -> None:
         cfg = self.cfg
-        now = driver._clock()
+        now = (self._clock or driver._clock)()
         if self._last_eval is not None and \
                 now - self._last_eval < cfg.evaluate_every_s:
             return

@@ -278,9 +278,18 @@ class ServiceEdge:
         tid = None
         if self.tracer is not None:
             # the trace starts the moment the edge accepted the bytes —
-            # fleet TTFT/E2E are measured from HERE, the client's view
+            # fleet TTFT/E2E are measured from HERE, the client's view.
+            # The root span carries the request's WORKLOAD identity
+            # (prompt length, budget, scheduling metadata) so a trace
+            # export is a replayable arrival trace — the
+            # ``dstpu_trace --workload`` / sim-replay surface
+            attrs = {"uid": uid, "prompt_tokens": len(item["tokens"])}
+            for k in ("max_new_tokens", "tenant", "priority", "slo_ms",
+                      "session", "deadline_ms"):
+                if item.get(k) is not None:
+                    attrs[k] = item[k]
             tid, root = self.tracer.mint("edge.recv", replica="edge",
-                                         attrs={"uid": uid})
+                                         attrs=attrs)
             item["trace"] = {"id": tid, "parent": root}
             with self._lock:
                 self._traces[uid] = tid

@@ -164,7 +164,7 @@ class FleetDriver:
     """
 
     def __init__(self, router, config: Optional[FleetConfig] = None,
-                 autoscaler=None):
+                 autoscaler=None, clock=None):
         self.router = router
         self.cfg = config or FleetConfig()
         self.autoscaler = autoscaler
@@ -195,7 +195,11 @@ class FleetDriver:
         self._started = False
         self._thread: Optional[threading.Thread] = None
         self._recovery_t0: Optional[float] = None
-        self._clock = time.monotonic
+        # injectable clock (ctor clock=): stamps the _rate_win sliding
+        # window behind tokens_per_second() — the drain-rate denominator
+        # of the edge's Retry-After math — plus the autoscale cadence and
+        # recovery-window gauges. The simulator's virtual-time seam.
+        self._clock = clock or time.monotonic
         self.counters: Dict[str, int] = dict(
             ticks=0, events=0, boundaries=0, cancels=0, submitted=0)
 

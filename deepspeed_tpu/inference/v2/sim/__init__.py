@@ -1,0 +1,34 @@
+"""Trace-driven fleet simulator (ROADMAP item 6).
+
+Replays recorded or synthesized arrival traces against the REAL policy
+stack — ``EngineRouter`` placement/failover/drain, ``RequestScheduler``
+admission/preemption/shed, ``ServiceEdge`` admission math,
+``AutoscaleController`` scale/flip laws all run unmodified — under a
+deterministic virtual clock, with per-frame cost read from the committed
+``.graft-cost-baseline.json`` instead of executing frames. A capacity
+question ("how many replicas for this traffic at this SLO?") answers in
+seconds on a laptop CPU; the ``--sim-fidelity`` bench row gates the
+model against a live threaded fleet on the same schedule.
+
+Layout::
+
+    clock.py    VirtualClock — shared seekable virtual time
+    cost.py     FrameCostModel — baseline metrics -> calibrated seconds
+    traffic.py  trace schema + seeded synthesizers (poisson/diurnal/...)
+    engine.py   SimEngine — the real serve-loop protocol, no frames
+    sim.py      FleetSimulator — real router/edge/autoscaler harness
+    tune.py     grid/random search over serving knobs
+"""
+
+from .clock import VirtualClock
+from .cost import CostCalibration, FrameCostModel
+from .engine import SimEngine
+from .sim import FleetSimulator, SimConfig, SimResult
+from .traffic import (load_trace, save_trace, synth_trace,
+                      TRACE_EVENT_KEYS)
+
+__all__ = [
+    "VirtualClock", "CostCalibration", "FrameCostModel", "SimEngine",
+    "FleetSimulator", "SimConfig", "SimResult",
+    "load_trace", "save_trace", "synth_trace", "TRACE_EVENT_KEYS",
+]

@@ -1,0 +1,41 @@
+"""Shared seekable virtual clock for the fleet simulator.
+
+One instance is threaded through every time seam the serving stack
+exposes (``ServingTelemetry(clock=...)``, ``RequestScheduler(clock=)``,
+``EngineRouter(clock=)``, ``AutoscaleController(clock=)``): a plain
+zero-argument callable returning seconds, exactly like
+``time.monotonic``, plus ``advance``/``seek`` for the simulator to move
+time.
+
+``seek`` may move BACKWARD: replicas keep independent local timelines
+(replica A can be at t=3.2 while B is still at t=3.0 — real fleets step
+concurrently; the sim steps them in turn), and the simulator positions
+the shared clock to a replica's local time before touching it so that
+telemetry TTFT/ITL and router heartbeat gaps read replica-local time.
+``advance`` is the strictly-forward form used while executing one
+replica's frame.
+"""
+
+
+class VirtualClock:
+    __slots__ = ("t",)
+
+    def __init__(self, t: float = 0.0):
+        self.t = float(t)
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        if dt < 0:
+            raise ValueError(f"advance({dt}): virtual time only moves "
+                             "forward; use seek() to reposition")
+        self.t += dt
+        return self.t
+
+    def seek(self, t: float) -> float:
+        self.t = float(t)
+        return self.t
+
+    def __repr__(self) -> str:
+        return f"VirtualClock(t={self.t:.6f})"

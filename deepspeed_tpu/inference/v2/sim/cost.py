@@ -1,0 +1,229 @@
+"""Frame-seconds model over the committed static cost ledger.
+
+The simulator never executes a frame; it prices one. The committed
+``.graft-cost-baseline.json`` gives exact static resource counts per
+traced frame program (FLOPs, HBM read+write bytes, collective wire
+bytes — see ``analysis.cost_model``); this module turns those counts
+into virtual SECONDS with a two-parameter affine model:
+
+    seconds = c0 + k * steps * work(program, live_frac)
+    work    = flops/F0 + (hbm_read+hbm_write)/B0 + collective_payload/W0
+
+``F0``/``B0``/``W0`` are fixed nominal device rates (they only set the
+relative weighting of compute vs memory vs interconnect; any common
+scale folds into ``k``), and ``(c0, k)`` — per-frame fixed overhead and
+the device's effective speed — are fitted by least squares from a
+handful of live boundary timings (``calibrate_from_boundaries``), with
+an optional per-ledger-program refinement for boundary overhead that
+differs by frame shape. Calibration is optional: the uncalibrated defaults give self-consistent RELATIVE
+capacity answers (2x the work is 2x the time), which is what a sweep
+frontier needs; the ``--sim-fidelity`` bench calibrates against a live
+run before comparing absolute percentiles.
+
+The ledger is keyed by program shape, so the model inherits the cost
+characteristics the lint stack enforces: a kernel change that shifts
+GL201 shifts the sim's capacity answers with it.
+"""
+
+import dataclasses
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ....analysis.cost_model import COST_BASELINE_PATH, FrameCostQuery
+
+# nominal device rates (per second). Absolute values are irrelevant —
+# they fold into the fitted k — but the RATIOS encode the roofline:
+# ~2e14 flop/s, ~8e11 HBM B/s, ~1e11 interconnect B/s is a generic
+# inference-accelerator shape (compute-rich, wire-poor).
+NOMINAL_FLOPS = 2.0e14
+NOMINAL_HBM_BPS = 8.0e11
+NOMINAL_WIRE_BPS = 1.0e11
+
+#: uncalibrated defaults: zero fixed overhead, unit speed. Chosen so an
+#: uncalibrated sim is deterministic and self-consistent, not accurate.
+DEFAULT_C0 = 2.0e-3
+DEFAULT_K = 1.0
+
+
+@dataclasses.dataclass
+class CostCalibration:
+    """Fitted ``(c0, k)`` plus provenance, JSON round-trippable.
+
+    ``per_program`` optionally refines the global pair per traced
+    ledger program: one affine over raw work cannot represent
+    host-side boundary overhead that differs by frame SHAPE (a wide
+    admission boundary reallocates device buffers and reserves KV
+    blocks; a steady decode boundary does neither), so programs with
+    enough samples carry their own ``{c0, k}``."""
+    c0: float = DEFAULT_C0
+    k: float = DEFAULT_K
+    n_samples: int = 0
+    residual: float = 0.0         # RMS relative residual of the fit
+    per_program: Optional[Dict[str, Dict[str, float]]] = None
+
+    def for_program(self, name: str) -> Tuple[float, float]:
+        entry = (self.per_program or {}).get(name)
+        if entry:
+            return float(entry["c0"]), float(entry["k"])
+        return self.c0, self.k
+
+    def to_json(self) -> Dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, data: Dict) -> "CostCalibration":
+        return cls(**{f.name: data[f.name]
+                      for f in dataclasses.fields(cls) if f.name in data})
+
+
+def fit_calibration(samples: Sequence[Tuple[float, float]]
+                    ) -> CostCalibration:
+    """Least-squares fit of ``dt = c0 + k * w`` from ``(w, dt)`` pairs.
+
+    ``w`` is the model's raw work term for the boundary (steps x
+    per-step work), ``dt`` the measured wall seconds. Compile-warmup
+    outliers must be excluded by the caller (``calibrate_from_boundaries``
+    does). Falls back to the defaults when the system is degenerate
+    (fewer than two distinct work values)."""
+    pts = [(float(w), float(dt)) for w, dt in samples
+           if dt > 0 and w > 0]
+    if len(pts) < 2 or len({round(w, 12) for w, _ in pts}) < 2:
+        return CostCalibration(n_samples=len(pts))
+    n = len(pts)
+    sw = sum(w for w, _ in pts)
+    st = sum(dt for _, dt in pts)
+    sww = sum(w * w for w, _ in pts)
+    swt = sum(w * dt for w, dt in pts)
+    det = n * sww - sw * sw
+    if det <= 0:
+        return CostCalibration(n_samples=n)
+    k = (n * swt - sw * st) / det
+    c0 = (st - k * sw) / n
+    # a pathological fit (negative slope from noise) is worse than the
+    # default: keep c0 >= 0 and k > 0 so virtual time is monotone
+    if k <= 0:
+        return CostCalibration(n_samples=n)
+    c0 = max(0.0, c0)
+    res = [abs((c0 + k * w) - dt) / dt for w, dt in pts]
+    rms = (sum(r * r for r in res) / n) ** 0.5
+    return CostCalibration(c0=c0, k=k, n_samples=n, residual=rms)
+
+
+class FrameCostModel:
+    """Prices one planned frame in virtual seconds (see module doc)."""
+
+    def __init__(self, query: Optional[FrameCostQuery] = None,
+                 calibration: Optional[CostCalibration] = None,
+                 baseline_path: str = COST_BASELINE_PATH):
+        self.query = query or FrameCostQuery.load(baseline_path)
+        self.calibration = calibration or CostCalibration()
+        self._work_cache: Dict[str, float] = {}
+
+    # -- raw work -----------------------------------------------------
+    def _program_work(self, name: str) -> float:
+        w = self._work_cache.get(name)
+        if w is None:
+            m = self.query.metrics(name)
+            w = (m["flops"] / NOMINAL_FLOPS
+                 + (m["hbm_read"] + m["hbm_write"]) / NOMINAL_HBM_BPS
+                 + m["collective_payload"] / NOMINAL_WIRE_BPS)
+            self._work_cache[name] = w
+        return w
+
+    def _resolve(self, *, steps: int, live: int, n_slots: int,
+                 width: int = 1, spec: bool = False, tp: int = 1,
+                 quant: bool = False) -> Tuple[str, float]:
+        """(ledger program name, raw work) for one frame plan.
+
+        The ledger prices a FULL pool; live rows scale the row-parallel
+        portion. ``live_frac`` never drops below one row's worth so an
+        almost-empty frame still pays the lockstep dispatch."""
+        name = self.query.frame_program(width=width, spec=spec, tp=tp,
+                                        quant=quant)
+        live_frac = max(1, live) / max(1, n_slots)
+        return name, float(steps) * live_frac * self._program_work(name)
+
+    def frame_work(self, **kw) -> float:
+        """Raw (unfitted) work for one frame plan."""
+        return self._resolve(**kw)[1]
+
+    def frame_seconds(self, **kw) -> float:
+        """Calibrated virtual seconds for one frame plan (the fitted
+        pair for this frame's ledger program when the calibration
+        carries one, else the global pair)."""
+        name, work = self._resolve(**kw)
+        c0, k = self.calibration.for_program(name)
+        return c0 + k * work
+
+
+def calibrate_from_boundaries(model: FrameCostModel,
+                              samples: Sequence[Dict],
+                              warmup_factor: float = 5.0
+                              ) -> CostCalibration:
+    """Fit ``(c0, k)`` from live serial-run boundary observations.
+
+    Each sample: ``{dt, steps, live, n_slots, width, spec, tp, quant}``
+    where ``dt`` is the wall-clock gap between consecutive
+    ``ServeBoundary.t`` stamps (telemetry records no per-frame wall
+    time, so boundary deltas are the only live timing source). Samples
+    whose dt exceeds ``warmup_factor`` x median are dropped: the first
+    boundary of each (width, steps) bucket pays XLA compilation, which
+    the virtual fleet never does.
+
+    Beyond the global affine, each ledger program with >= 2 surviving
+    samples gets its own sub-fit (see ``CostCalibration.per_program``).
+    A degenerate sub-group — one distinct work value, so no slope
+    information — anchors its intercept at the group's mean dt instead,
+    borrowing the global slope when that fit is trustworthy (relative
+    residual < 0.5) and the unit default otherwise."""
+    pts: List[Tuple[float, float]] = []
+    groups: Dict[str, List[Tuple[float, float]]] = {}
+    dts = sorted(float(s["dt"]) for s in samples if s.get("dt", 0) > 0)
+    if not dts:
+        return CostCalibration()
+    median = dts[len(dts) // 2]
+    for s in samples:
+        dt = float(s.get("dt", 0))
+        if dt <= 0 or dt > warmup_factor * median:
+            continue
+        name, w = model._resolve(
+            steps=int(s.get("steps", 1)), live=int(s.get("live", 1)),
+            n_slots=int(s.get("n_slots", 1)),
+            width=int(s.get("width", 1)), spec=bool(s.get("spec")),
+            tp=int(s.get("tp", 1)), quant=bool(s.get("quant")))
+        pts.append((w, dt))
+        groups.setdefault(name, []).append((w, dt))
+    cal = fit_calibration(pts)
+    k_anchor = (cal.k if cal.n_samples and cal.residual < 0.5
+                else DEFAULT_K)
+    per: Dict[str, Dict[str, float]] = {}
+    for name, g in groups.items():
+        if len(g) < 2:
+            continue
+        sub = fit_calibration(g)
+        degenerate = (sub.c0 == DEFAULT_C0 and sub.k == DEFAULT_K
+                      and sub.residual == 0.0)
+        if degenerate:
+            mean_w = sum(w for w, _ in g) / len(g)
+            mean_dt = sum(dt for _, dt in g) / len(g)
+            sub = CostCalibration(
+                c0=max(0.0, mean_dt - k_anchor * mean_w), k=k_anchor,
+                n_samples=len(g))
+        per[name] = {"c0": sub.c0, "k": sub.k,
+                     "n_samples": sub.n_samples,
+                     "residual": sub.residual}
+    if per:
+        cal = dataclasses.replace(cal, per_program=per)
+    model.calibration = cal
+    return cal
+
+
+def save_calibration(path: str, cal: CostCalibration) -> None:
+    with open(path, "w") as fh:
+        json.dump(cal.to_json(), fh, indent=2, sort_keys=True)
+        fh.write("\n")
+
+
+def load_calibration(path: str) -> CostCalibration:
+    with open(path) as fh:
+        return CostCalibration.from_json(json.load(fh))

@@ -1,0 +1,560 @@
+"""FleetSimulator: the trace-driven discrete-event harness.
+
+Builds a fleet of :class:`~.engine.SimEngine` replicas and drives them
+through the REAL ``EngineRouter`` serial stepping loop — placement,
+affinity, failover, drains, rejoins, role flips all run the production
+code — with the REAL ``RequestScheduler`` per replica, the REAL
+``ServiceEdge.admission_check`` math in front (no HTTP server), and the
+REAL ``AutoscaleController`` on the tick path. The only substitutions
+are the frame (virtual token arithmetic priced by the committed cost
+baseline) and the clock (a shared :class:`~.clock.VirtualClock`).
+
+Time model: each replica keeps its own ``local_t`` timeline (real
+fleets step concurrently; the sim steps them in turn) and seeks the
+shared clock to it while running. The arrival feeder gates delivery on
+``min(local_t)`` over steppable replicas — an event is never delivered
+before every replica has simulated past its arrival instant — and
+fast-forwards the whole fleet across idle gaps, so simulated seconds
+cost microseconds of wall time. Idle replicas are lifted to the fleet
+frontier each tick, bounding cross-replica skew at one frame.
+
+Determinism: everything downstream of the trace is pure arithmetic on
+seeded/deterministic inputs, so the same (trace, config) pair produces
+a byte-identical event log — ``SimResult.checkpoint`` carries the log's
+sha256, and ``run(resume_checkpoint=...)`` re-derives the run from t=0
+and ASSERTS the prefix digest at the recorded barrier before continuing
+(a replay checkpoint: state is recomputed, never serialized).
+"""
+
+import copy
+import dataclasses
+import hashlib
+import heapq
+import json
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..engine_v2 import RaggedInferenceEngineConfig
+from ..faults import snapshot_split
+from ..router import DEAD, DRAINING, HEALTHY, EngineRouter, RouterConfig
+from ..scheduler import RequestScheduler, SchedulerConfig
+from ..service.autoscale import AutoscaleConfig, AutoscaleController
+from ..service.edge import EdgeConfig, ServiceEdge
+from .clock import VirtualClock
+from .cost import CostCalibration, FrameCostModel
+from .engine import SimEngine, SimSwapTier
+from .traffic import prompt_for, session_prefix_for
+
+
+class _SimHalt(Exception):
+    """Internal: clean mid-run stop (barrier snapshot / safety limit)."""
+
+
+def _item_tokens(item) -> int:
+    if isinstance(item, dict):
+        return len(item["tokens"]) + len(item.get("generated") or ())
+    return len(item[1])
+
+
+def _pct(xs: List[float], p: float) -> Optional[float]:
+    if not xs:
+        return None
+    xs = sorted(xs)
+    k = min(len(xs) - 1, max(0, -(-int(p * len(xs)) // 100) - 1))
+    return xs[k]
+
+
+@dataclasses.dataclass
+class SimConfig:
+    """One simulated deployment: fleet shape + every policy config the
+    real stack takes, passed through UNMODIFIED to the real objects."""
+    replicas: int = 1
+    #: per-replica roles ("unified" | "prefill" | "decode"); None = all
+    #: unified. Any prefill role gets the fleet one shared SimSwapTier.
+    roles: Optional[Sequence[str]] = None
+    #: engine config template, copied per replica (role overridden)
+    engine: Optional[RaggedInferenceEngineConfig] = None
+    scheduler: Optional[SchedulerConfig] = None
+    router: Optional[RouterConfig] = None
+    #: None = no autoscaler on the tick path
+    autoscale: Optional[AutoscaleConfig] = None
+    #: None = no edge admission gate in front of the router
+    edge: Optional[EdgeConfig] = None
+    #: shed clients re-offer after the edge's Retry-After this many times
+    edge_max_retries: int = 3
+    max_new_tokens: int = 32
+    speculate: bool = False
+    gamma: Optional[int] = None          # None = engine config's
+    calibration: Optional[CostCalibration] = None
+    spec_acceptance: float = 0.7
+    idle_poll_s: float = 0.002
+    max_seq_len: int = 4096
+    rate_window_s: float = 10.0
+    #: safety rails: a misconfigured sim must fail, not spin
+    max_virtual_s: Optional[float] = None
+    max_ticks: int = 1_000_000
+
+    def describe(self) -> Dict:
+        e = self.engine or RaggedInferenceEngineConfig()
+        return {
+            "replicas": self.replicas,
+            "roles": (list(self.roles) if self.roles
+                      else ["unified"] * self.replicas),
+            "slots": e.max_ragged_batch_size,
+            "frame_steps": e.frame_steps,
+            "adaptive_frame_steps": e.adaptive_frame_steps,
+            "prefill_chunk_size": e.prefill_chunk_size,
+            "prefix_cache": e.prefix_cache,
+            "prefix_cache_max_blocks": e.prefix_cache_max_blocks,
+            "speculate": self.speculate,
+            "gamma": (self.gamma if self.gamma is not None
+                      else e.speculate_gamma),
+            "max_new_tokens": self.max_new_tokens,
+            "edge": self.edge is not None,
+            "autoscale": self.autoscale is not None,
+        }
+
+
+class _SimDriver:
+    """The fleet-driver facade the edge and autoscaler consume —
+    ``queued_tokens_estimate`` / ``best_placement_score`` /
+    ``tokens_per_second`` mirror ``service.fleet.FleetDriver``'s
+    pressure-cache math exactly (same terms, same windows), computed
+    from the serial router's state on the virtual clock."""
+
+    def __init__(self, router: EngineRouter, clock: VirtualClock,
+                 rate_window_s: float):
+        self.router = router
+        self._clock = clock
+        self._rate_window_s = rate_window_s
+        self._rate_win: List[Tuple[float, int]] = []
+        self._queued_tokens_cache = 0
+        self._ingress_tokens = 0        # the sim has no HTTP ingress queue
+        self._best_score_cache: Optional[float] = None
+        self._tps_cache = 0.0
+
+    def refresh(self) -> None:
+        rt = self.router
+        total = 0
+        for r in rt._replicas.values():
+            b = r.last_boundary
+            if b is not None and r.status in (HEALTHY, DRAINING):
+                total += b.queued_tokens or 0
+            total += rt._feed_prompt_tokens(r)
+        for _, item, _ in rt._deferred:
+            total += _item_tokens(item)
+        for item, _ in rt._unplaced:
+            total += _item_tokens(item)
+        self._queued_tokens_cache = total
+        scores = [rt._score(r) for r in rt._replicas.values()
+                  if r.accepting()]
+        self._best_score_cache = min(scores) if scores else None
+        now = self._clock()
+        while self._rate_win and \
+                now - self._rate_win[0][0] > self._rate_window_s:
+            self._rate_win.pop(0)
+        toks = sum(n for _, n in self._rate_win)
+        span = max(now - self._rate_win[0][0], 1e-3) if self._rate_win \
+            else 1.0
+        self._tps_cache = toks / span if toks else 0.0
+
+    def note_completion(self, n_tokens: int) -> None:
+        self._rate_win.append((self._clock(), int(n_tokens)))
+
+    # -- the edge/autoscaler read surface ------------------------------
+    def queued_tokens_estimate(self) -> int:
+        return self._queued_tokens_cache + self._ingress_tokens
+
+    def best_placement_score(self) -> Optional[float]:
+        return self._best_score_cache
+
+    def tokens_per_second(self) -> float:
+        return self._tps_cache
+
+    def in_flight(self) -> int:
+        return len(self.router._assignment)
+
+    def request_role_flip(self, name: str, role: str) -> bool:
+        """Autoscaler surface: the serial-loop equivalent of
+        ``FleetDriver.request_role_flip`` — same refusal rules (HEALTHY
+        only, never strand decode capacity, pre-validate), then a
+        synchronous generator restart with the queue migrated exactly
+        like a drain (snapshot -> re-place), so nothing is lost."""
+        rt = self.router
+        r = rt._replicas.get(name)
+        if r is None or r.status != HEALTHY:
+            return False
+        if role == "prefill":
+            eff_nonprefill = [
+                n for n, ro in rt._roles.items()
+                if ro != "prefill" and n != name
+                and rt._replicas[n].status != DEAD]
+            if not eff_nonprefill:
+                return False
+        try:
+            rt.validate_replica_role(name, role)
+        except (ValueError, KeyError):
+            return False
+        snap = r.engine.snapshot_serving_state() if r.gen is not None \
+            else None
+        rt._close_gen(r)
+        try:
+            r.engine.set_role(role)
+            rt.set_replica_role(name, role)
+        except Exception:                # noqa: BLE001 — refusal, not crash
+            return False
+        rt.counters["scale_role_flips"] += 1
+        held = list(r.feed)
+        r.feed.clear()
+        for item in held:
+            rt._place(item)
+        if snap:
+            for item in rt._restamp_affinity(snapshot_split(snap)):
+                rt._place(item)
+        return True
+
+
+@dataclasses.dataclass
+class SimResult:
+    """One simulated run: the capacity answer plus the evidence."""
+    config: Dict
+    completed: int
+    tokens_out: int
+    duration_s: float                 # virtual makespan (max local_t)
+    tokens_per_s: float
+    virtual_frames: int
+    virtual_steps: int
+    #: schedule-relative fleet percentiles (ms) from the event log:
+    #: TTFT/E2E measured from the trace's INTENDED arrival instant
+    latency: Dict[str, Dict]
+    #: per-replica ServingTelemetry.latency_ms() — the engine-local view
+    #: the live fleet exports (the --sim-fidelity comparison surface)
+    telemetry: Dict[str, Dict]
+    counters: Dict[str, int]          # router counters
+    sheds: Dict[str, int]
+    preempts: int
+    handoffs: int
+    faults: int
+    autoscale_events: List[Dict]
+    events: List[Dict]
+    #: replay checkpoint over the full log: {"events": n, "sha256": hex}
+    checkpoint: Dict = dataclasses.field(default_factory=dict)
+
+    def event_lines(self) -> List[str]:
+        return [json.dumps(e, sort_keys=True) for e in self.events]
+
+    def to_json(self) -> Dict:
+        out = dataclasses.asdict(self)
+        del out["events"]
+        return out
+
+
+class FleetSimulator:
+    """See module docstring. One instance = one deployment under test;
+    ``run(trace)`` builds a FRESH fleet each call (no state carries
+    over), replays the trace, and returns a :class:`SimResult`."""
+
+    def __init__(self, config: Optional[SimConfig] = None):
+        self.cfg = config or SimConfig()
+        self.clock: Optional[VirtualClock] = None
+        self.router: Optional[EngineRouter] = None
+        self.driver: Optional[_SimDriver] = None
+        self.edge: Optional[ServiceEdge] = None
+        self.autoscaler: Optional[AutoscaleController] = None
+        self.engines: Dict[str, SimEngine] = {}
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    def _build(self) -> None:
+        cfg = self.cfg
+        if cfg.replicas < 1:
+            raise ValueError("SimConfig.replicas must be >= 1")
+        roles = list(cfg.roles) if cfg.roles else \
+            ["unified"] * cfg.replicas
+        if len(roles) != cfg.replicas:
+            raise ValueError(f"roles has {len(roles)} entries for "
+                             f"{cfg.replicas} replicas")
+        self.clock = VirtualClock()
+        cost = FrameCostModel(calibration=cfg.calibration)
+        tier = SimSwapTier() if any(r == "prefill" for r in roles) \
+            else None
+        template = cfg.engine or RaggedInferenceEngineConfig()
+        self.engines = {}
+        for i, role in enumerate(roles):
+            e_cfg = copy.deepcopy(template)
+            e_cfg.role = role
+            self.engines[f"sim{i}"] = SimEngine(
+                config=e_cfg, clock=self.clock, cost_model=cost,
+                max_seq_len=cfg.max_seq_len, sink=self._sink,
+                spec_acceptance=cfg.spec_acceptance,
+                idle_poll_s=cfg.idle_poll_s, kv_swap=tier,
+                name=f"sim{i}")
+        r_cfg = cfg.router or RouterConfig()
+        if r_cfg.driver != "serial":
+            raise ValueError("the simulator drives the serial router "
+                             f"loop; RouterConfig.driver={r_cfg.driver!r}")
+        self.router = EngineRouter(self.engines, r_cfg, clock=self.clock)
+        self.driver = _SimDriver(self.router, self.clock,
+                                 cfg.rate_window_s)
+        self.edge = ServiceEdge(self.driver, cfg.edge) \
+            if cfg.edge is not None else None
+        self.autoscaler = AutoscaleController(cfg.autoscale,
+                                              clock=self.clock) \
+            if cfg.autoscale is not None else None
+
+    # ------------------------------------------------------------------
+    # event log
+    # ------------------------------------------------------------------
+
+    def _log(self, kind: str, uid=None, t=None, engine="", **kw) -> None:
+        ev = {"kind": kind, "t": float(t if t is not None
+                                       else self.clock()),
+              "engine": engine}
+        if uid is not None:
+            ev["uid"] = int(uid)
+        for k, v in kw.items():
+            if v is not None:
+                ev[k] = v
+        self._events.append(ev)
+        self._sha.update((json.dumps(ev, sort_keys=True) + "\n").encode())
+        if self._barrier_n is not None and \
+                len(self._events) == self._barrier_n:
+            self._barrier_digest = self._sha.hexdigest()
+
+    def _sink(self, kind: str, uid=None, t=None, engine="", **kw) -> None:
+        self._log(kind, uid=uid, t=t, engine=engine, **kw)
+
+    # ------------------------------------------------------------------
+    # the arrival feeder (polled by the router once per tick)
+    # ------------------------------------------------------------------
+
+    def _fleet_idle(self, steppable) -> bool:
+        rt = self.router
+        if rt._assignment or rt._deferred or rt._unplaced:
+            return False
+        for r in steppable:
+            b = r.last_boundary
+            if r.feed or (b is not None and (b.live or b.queued)):
+                return False
+        return True
+
+    def _build_item(self, ev: Dict) -> Dict:
+        prefix = session_prefix_for(ev["session"]) \
+            if ev.get("session") else None
+        item = {"uid": int(ev["uid"]),
+                "tokens": prompt_for(int(ev["uid"]),
+                                     int(ev["prompt_tokens"]),
+                                     session_prefix=prefix)}
+        if ev.get("max_new_tokens") is not None:
+            item["max_new_tokens"] = int(ev["max_new_tokens"])
+        for k in ("tenant", "priority", "slo_ms", "session",
+                  "deadline_ms"):
+            if ev.get(k) is not None:
+                item[k] = ev[k]
+        return item
+
+    def _feeder(self, trace: List[Dict]):
+        cfg = self.cfg
+        i = 0
+        retries: List[Tuple[float, int, int, Dict]] = []   # heap
+        retry_seq = 0
+        tick = -1
+        while True:
+            tick += 1
+            if tick > cfg.max_ticks:
+                self._log("halt", reason=f"max_ticks={cfg.max_ticks}")
+                raise _SimHalt
+            rt = self.router
+            steppable = [r for r in rt._replicas.values()
+                         if r.status in (HEALTHY, DRAINING)]
+            # skew control: idle replicas ride the fleet frontier so the
+            # delivery gate tracks the busy replicas, not a 2ms-per-tick
+            # idle poll
+            if steppable:
+                front = max(r.engine.local_t for r in steppable)
+                for r in steppable:
+                    b = r.last_boundary
+                    if not r.feed and (b is None
+                                       or (b.live == 0 and b.queued == 0)):
+                        r.engine.local_t = max(r.engine.local_t, front)
+                gate = min(r.engine.local_t for r in steppable)
+            else:
+                gate = self.clock()
+            self.clock.seek(gate)
+            self.driver.refresh()
+            if self.autoscaler is not None:
+                n0 = len(self.autoscaler.events)
+                self.autoscaler.on_tick(self.driver, tick)
+                for ev in self.autoscaler.events[n0:]:
+                    self._log("autoscale", **ev)
+            # next pending instant (trace or client retry)
+            nxt = trace[i]["t"] if i < len(trace) else None
+            if retries and (nxt is None or retries[0][0] < nxt):
+                nxt = retries[0][0]
+            # fleet-wide idle fast-forward: nothing in flight anywhere
+            # and the next event is in the future — jump to it
+            if nxt is not None and nxt > gate and steppable \
+                    and self._fleet_idle(steppable):
+                for r in steppable:
+                    r.engine.local_t = max(r.engine.local_t, nxt)
+                gate = nxt
+                self.clock.seek(gate)
+            if cfg.max_virtual_s is not None and gate > cfg.max_virtual_s:
+                self._log("halt",
+                          reason=f"max_virtual_s={cfg.max_virtual_s}")
+                raise _SimHalt
+            # deliver everything due at the gate, in arrival order
+            batch = []
+            while True:
+                due_retry = retries and retries[0][0] <= gate and \
+                    (i >= len(trace) or retries[0][0] <= trace[i]["t"])
+                if due_retry:
+                    _, _, attempt, ev = heapq.heappop(retries)
+                elif i < len(trace) and trace[i]["t"] <= gate:
+                    ev, attempt = trace[i], 0
+                    i += 1
+                else:
+                    break
+                uid = int(ev["uid"])
+                if self.edge is not None:
+                    self.edge._inc("requests")
+                    verdict = self.edge.admission_check()
+                    if verdict is not None:
+                        self.edge._inc("sheds")
+                        will_retry = attempt < cfg.edge_max_retries
+                        self._log("edge_shed", uid,
+                                  reason=verdict["reason"],
+                                  retry_after_s=verdict["retry_after_s"],
+                                  attempt=attempt, will_retry=will_retry)
+                        if will_retry:
+                            retry_seq += 1
+                            heapq.heappush(retries, (
+                                gate + verdict["retry_after_s"],
+                                retry_seq, attempt + 1, ev))
+                        continue
+                self._log("arrival", uid, sched_t=ev["t"],
+                          attempt=attempt,
+                          prompt_tokens=int(ev["prompt_tokens"]))
+                batch.append(self._build_item(ev))
+            if self._stop_n is not None and \
+                    len(self._events) >= self._stop_n:
+                raise _SimHalt
+            if i >= len(trace) and not retries:
+                if batch:
+                    yield batch
+                return
+            yield batch
+
+    # ------------------------------------------------------------------
+    # run
+    # ------------------------------------------------------------------
+
+    def run(self, trace: List[Dict], *,
+            stop_after_events: Optional[int] = None,
+            resume_checkpoint: Optional[Dict] = None,
+            faults=None) -> SimResult:
+        """Replay ``trace`` (a list of traffic.py arrival events) through
+        a fresh fleet. ``stop_after_events`` halts at the first tick with
+        that many events logged (the returned checkpoint is the barrier
+        snapshot); ``resume_checkpoint`` re-derives the run from t=0 and
+        asserts the event-log prefix digest at the recorded barrier.
+        ``faults`` takes a ``RouterFaultInjector`` for chaos sims."""
+        cfg = self.cfg
+        self._build()
+        self._events: List[Dict] = []
+        self._sha = hashlib.sha256()
+        self._stop_n = stop_after_events
+        self._barrier_n = resume_checkpoint["events"] \
+            if resume_checkpoint else None
+        self._barrier_digest: Optional[str] = None
+        completions: Dict[int, int] = {}
+        gen = self.router.serve(
+            self._feeder(trace), max_new_tokens=cfg.max_new_tokens,
+            temperature=0.0, eos_token_id=None,
+            scheduler_factory=lambda: RequestScheduler(
+                cfg.scheduler, clock=self.clock),
+            faults=faults,
+            engine_kwargs={"speculate": cfg.speculate,
+                           "gamma": cfg.gamma})
+        try:
+            for uid, toks in gen:
+                self.driver.note_completion(len(toks))
+                self._log("complete", uid, n=len(toks))
+                completions[int(uid)] = len(toks)
+        except _SimHalt:
+            pass
+        finally:
+            gen.close()
+        if resume_checkpoint is not None:
+            want = resume_checkpoint["sha256"]
+            if self._barrier_digest != want:
+                raise RuntimeError(
+                    "sim resume divergence: event-log prefix digest at "
+                    f"barrier {resume_checkpoint['events']} is "
+                    f"{self._barrier_digest}, checkpoint recorded {want}")
+        return self._result(trace, completions)
+
+    def _result(self, trace: List[Dict],
+                completions: Dict[int, int]) -> SimResult:
+        events = self._events
+        sched_t: Dict[int, float] = {}
+        first_t: Dict[int, float] = {}
+        done_t: Dict[int, float] = {}
+        done_n: Dict[int, int] = {}
+        kinds: Dict[str, int] = {}
+        for e in events:
+            kinds[e["kind"]] = kinds.get(e["kind"], 0) + 1
+            uid = e.get("uid")
+            if e["kind"] == "arrival" and uid not in sched_t:
+                sched_t[uid] = e["sched_t"]
+            elif e["kind"] == "first_token" and uid not in first_t:
+                first_t[uid] = e["t"]
+            elif e["kind"] == "retire":
+                done_t[uid] = e["t"]
+                done_n[uid] = e["n"]
+        ttft = [(first_t[u] - sched_t[u]) * 1e3
+                for u in first_t if u in sched_t]
+        e2e = [(done_t[u] - sched_t[u]) * 1e3
+               for u in done_t if u in sched_t]
+        itl = [(done_t[u] - first_t[u]) / (done_n[u] - 1) * 1e3
+               for u in done_t
+               if u in first_t and done_n.get(u, 0) > 1]
+        latency = {
+            name: {"count": len(xs),
+                   "p50": _pct(xs, 50), "p90": _pct(xs, 90),
+                   "p99": _pct(xs, 99)}
+            for name, xs in (("ttft", ttft), ("itl", itl), ("e2e", e2e))}
+        duration = max([e.engine.local_t
+                        for e in self.router._replicas.values()] or [0.0])
+        tokens_out = sum(completions.values())
+        edge_sheds = sum(1 for e in events if e["kind"] == "edge_shed")
+        edge_dropped = sum(1 for e in events if e["kind"] == "edge_shed"
+                           and not e.get("will_retry"))
+        return SimResult(
+            config=self.cfg.describe(),
+            completed=len(completions),
+            tokens_out=tokens_out,
+            duration_s=round(duration, 9),
+            tokens_per_s=round(tokens_out / duration, 3) if duration
+            else 0.0,
+            virtual_frames=sum(e.virtual_frames
+                               for e in self.engines.values()),
+            virtual_steps=sum(e.virtual_steps
+                              for e in self.engines.values()),
+            latency=latency,
+            telemetry={name: eng.telemetry.latency_ms()
+                       for name, eng in self.engines.items()},
+            counters=dict(self.router.counters),
+            sheds={"edge": edge_sheds, "edge_dropped": edge_dropped,
+                   "engine": kinds.get("shed", 0)},
+            preempts=kinds.get("preempt", 0),
+            handoffs=kinds.get("handoff_out", 0),
+            faults=kinds.get("fault", 0),
+            autoscale_events=[dict(e) for e in
+                              (self.autoscaler.events
+                               if self.autoscaler else [])],
+            events=events,
+            checkpoint={"events": len(events),
+                        "sha256": self._sha.hexdigest()},
+        )

@@ -1,0 +1,157 @@
+"""Arrival-trace schema and seeded traffic synthesizers.
+
+One trace = a list of arrival events, each a plain dict::
+
+    {"t": float seconds from trace start,   # required
+     "uid": int,                            # required, unique
+     "prompt_tokens": int,                  # required
+     "max_new_tokens": int,
+     "tenant": str, "priority": str, "slo_ms": float,
+     "session": str, "deadline_ms": float}
+
+— deliberately the same shape ``tracing.extract_workload`` emits from a
+recorded ``dstpu_trace`` export, so recorded and synthetic traffic are
+interchangeable everywhere downstream. Serialized one JSON object per
+line with sorted keys (byte-stable: the determinism tests hash files).
+
+Synthesizers are seeded ``random.Random`` — same seed, same trace,
+byte-for-byte. Prompt token VALUES are synthesized deterministically
+from the uid (and shared per session prefix, so the prefix-cache model
+in the simulator has something real to hit).
+"""
+
+import json
+import math
+import random
+from typing import Dict, List, Optional
+
+TRACE_EVENT_KEYS = ("t", "uid", "prompt_tokens", "max_new_tokens",
+                    "tenant", "priority", "slo_ms", "session",
+                    "deadline_ms")
+
+#: profiles understood by ``synth_trace`` (and ``dstpu_sim --profile``)
+PROFILES = ("poisson", "diurnal", "bursty", "heavy_tail")
+
+
+def save_trace(path: str, events: List[Dict]) -> None:
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev, sort_keys=True) + "\n")
+
+
+def load_trace(path: str) -> List[Dict]:
+    events = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if line:
+                events.append(json.loads(line))
+    _validate(events)
+    return events
+
+
+def _validate(events: List[Dict]) -> None:
+    seen = set()
+    last_t = -math.inf
+    for i, ev in enumerate(events):
+        for k in ("t", "uid", "prompt_tokens"):
+            if k not in ev:
+                raise ValueError(f"trace event {i} missing {k!r}: {ev}")
+        if ev["t"] < last_t:
+            raise ValueError(f"trace not sorted by t at event {i}")
+        last_t = ev["t"]
+        if ev["uid"] in seen:
+            raise ValueError(f"duplicate uid {ev['uid']} at event {i}")
+        seen.add(ev["uid"])
+
+
+def prompt_for(uid: int, n: int, vocab: int = 32000,
+               session_prefix: Optional[List[int]] = None) -> List[int]:
+    """Deterministic prompt token values for a trace event.
+
+    A session-shared prefix (same for every request in the session)
+    followed by uid-derived filler — gives the prefix cache real common
+    prefixes to discover without storing token arrays in the trace."""
+    prefix = list(session_prefix or [])[:max(0, n - 1)]
+    body = [((uid * 2654435761 + 97 + i * 31) % (vocab - 2)) + 2
+            for i in range(n - len(prefix))]
+    return prefix + body
+
+
+def session_prefix_for(session: str, n: int = 24,
+                       vocab: int = 32000) -> List[int]:
+    h = 2166136261
+    for ch in session:
+        h = ((h ^ ord(ch)) * 16777619) & 0xFFFFFFFF
+    return [((h + i * 131) % (vocab - 2)) + 2 for i in range(n)]
+
+
+def synth_trace(profile: str = "poisson", *, rate: float = 4.0,
+                duration_s: float = 30.0, seed: int = 0,
+                prompt_mean: int = 48, prompt_max: int = 192,
+                new_tokens_mean: int = 24, new_tokens_max: int = 96,
+                tenants: int = 2, sessions: int = 0,
+                interactive_frac: float = 0.5,
+                slo_ms: Optional[float] = None,
+                uid_base: int = 1) -> List[Dict]:
+    """Seeded synthetic arrival trace (see PROFILES).
+
+    * ``poisson`` — homogeneous Poisson at ``rate`` req/s.
+    * ``diurnal`` — sinusoidal rate between 0.25x and 1.75x ``rate``
+      over one period = ``duration_s`` (a compressed day).
+    * ``bursty`` — Poisson background plus square bursts at 4x rate for
+      10% of each quarter-period (thundering herds).
+    * ``heavy_tail`` — Poisson arrivals, but prompt and output lengths
+      drawn log-normal: a few giants among many dwarves (the
+      adversarial case for frame-lockstep schedulers).
+    """
+    if profile not in PROFILES:
+        raise ValueError(f"unknown profile {profile!r}; one of {PROFILES}")
+    rng = random.Random(seed)
+    events: List[Dict] = []
+    t = 0.0
+    uid = uid_base
+
+    def local_rate(now: float) -> float:
+        if profile == "diurnal":
+            return rate * (1.0 + 0.75 * math.sin(
+                2 * math.pi * now / max(1e-9, duration_s)))
+        if profile == "bursty":
+            q = max(1e-9, duration_s / 4.0)
+            return rate * (4.0 if (now % q) < 0.1 * q else 1.0)
+        return rate
+
+    def draw_len(mean: int, cap: int) -> int:
+        if profile == "heavy_tail":
+            # log-normal with sigma=1: median well under the mean, tail
+            # out to the cap
+            v = int(rng.lognormvariate(math.log(max(2, mean * 0.6)), 1.0))
+        else:
+            v = int(rng.expovariate(1.0 / max(1, mean))) + 1
+        return max(1, min(cap, v))
+
+    while True:
+        # thinning: sample at the peak rate, accept at local/peak
+        peak = rate * (4.0 if profile == "bursty" else
+                       1.75 if profile == "diurnal" else 1.0)
+        t += rng.expovariate(peak)
+        if t >= duration_s:
+            break
+        if rng.random() > local_rate(t) / peak:
+            continue
+        ev: Dict = {
+            "t": round(t, 9),
+            "uid": uid,
+            "prompt_tokens": draw_len(prompt_mean, prompt_max),
+            "max_new_tokens": draw_len(new_tokens_mean, new_tokens_max),
+            "tenant": f"tenant{rng.randrange(max(1, tenants))}",
+            "priority": ("interactive"
+                         if rng.random() < interactive_frac else "batch"),
+        }
+        if slo_ms is not None:
+            ev["slo_ms"] = float(slo_ms)
+        if sessions > 0 and rng.random() < 0.5:
+            ev["session"] = f"sess{rng.randrange(sessions)}"
+        events.append(ev)
+        uid += 1
+    return events

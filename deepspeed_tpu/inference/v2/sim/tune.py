@@ -1,0 +1,206 @@
+"""Capacity sweeps and knob search over the simulator.
+
+``sweep_capacity`` answers the headline question — how many replicas
+does this traffic need at this SLO — by simulating the SAME trace at
+each fleet size. ``tune`` searches the serving-knob space (grid or
+seeded-random) and returns a ranked table plus a ``serve_config`` JSON
+blob ``bin/dstpu_serve --config`` loads directly, so the sim's answer
+deploys without transcription.
+
+Both are thin deterministic loops over :class:`~.sim.FleetSimulator`;
+with the default (uncalibrated) cost model the answers are RELATIVE —
+calibrate against a live run (``cost.calibrate_from_boundaries``) for
+absolute percentiles.
+"""
+
+import copy
+import itertools
+import random
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..engine_v2 import RaggedInferenceEngineConfig
+from ..scheduler import SchedulerConfig
+from ..service.edge import EdgeConfig
+from .sim import FleetSimulator, SimConfig, SimResult
+
+SERVE_CONFIG_VERSION = 1
+
+#: the default search space: the knobs the ISSUE names, kept small
+#: enough that random sampling covers it meaningfully in ~24 draws
+DEFAULT_SPACE: Dict[str, Sequence] = {
+    "frame_steps": (2, 4, 8, 16),
+    "prefill_chunk_size": (32, 64, 128),
+    "speculate_gamma": (0, 2, 4),          # 0 = speculation off
+    "prefix_cache_max_blocks": (None, 64, 256),   # None = cache off
+    "lookahead_reserve": (False, True),
+    "max_queued_tokens": (None, 512, 2048),       # edge admission
+}
+
+
+def apply_knobs(base: SimConfig, knobs: Dict) -> SimConfig:
+    """One candidate deployment: ``base`` with ``knobs`` overlaid on the
+    real config objects (engine / scheduler / edge)."""
+    cfg = copy.deepcopy(base)
+    e = cfg.engine or RaggedInferenceEngineConfig()
+    cfg.engine = e
+    if "frame_steps" in knobs:
+        e.frame_steps = int(knobs["frame_steps"])
+    if "prefill_chunk_size" in knobs:
+        e.prefill_chunk_size = int(knobs["prefill_chunk_size"])
+    if "speculate_gamma" in knobs:
+        g = int(knobs["speculate_gamma"])
+        cfg.speculate = g > 0
+        cfg.gamma = g if g > 0 else None
+        e.speculate_gamma = max(g, 1)
+    if "prefix_cache_max_blocks" in knobs:
+        blocks = knobs["prefix_cache_max_blocks"]
+        e.prefix_cache = blocks is not None
+        e.prefix_cache_max_blocks = blocks
+    if "lookahead_reserve" in knobs:
+        s = cfg.scheduler or SchedulerConfig()
+        s.lookahead_reserve = bool(knobs["lookahead_reserve"])
+        cfg.scheduler = s
+    if "max_queued_tokens" in knobs or "shed_score" in knobs:
+        ec = cfg.edge or EdgeConfig(trace=False)
+        if "max_queued_tokens" in knobs:
+            ec.max_queued_tokens = knobs["max_queued_tokens"]
+        if "shed_score" in knobs:
+            ec.shed_score = knobs["shed_score"]
+        cfg.edge = ec
+    return cfg
+
+
+def default_score(result: SimResult, n_requests: int) -> float:
+    """Lower is better: interactive latency first, with order-of-
+    magnitude penalties for dropped/shed work so no latency win can buy
+    its way past losing requests."""
+    lat = result.latency
+    ttft = lat["ttft"]["p90"] if lat["ttft"]["p90"] is not None else 1e6
+    itl = lat["itl"]["p90"] or 0.0
+    dropped = max(0, n_requests - result.completed)
+    return (ttft + 0.5 * itl + 1e4 * dropped
+            + 100.0 * result.sheds["engine"]
+            + 100.0 * result.sheds["edge_dropped"])
+
+
+def _result_row(result: SimResult) -> Dict:
+    # SimResult.latency is already milliseconds (sim.py converts)
+    return {
+        "completed": result.completed,
+        "tokens_per_s": result.tokens_per_s,
+        "duration_s": result.duration_s,
+        "ttft_p50_ms": result.latency["ttft"]["p50"],
+        "ttft_p90_ms": result.latency["ttft"]["p90"],
+        "itl_p50_ms": result.latency["itl"]["p50"],
+        "itl_p90_ms": result.latency["itl"]["p90"],
+        "e2e_p90_ms": result.latency["e2e"]["p90"],
+        "sheds": dict(result.sheds),
+        "preempts": result.preempts,
+        "virtual_frames": result.virtual_frames,
+    }
+
+
+def sweep_capacity(trace: List[Dict], base: Optional[SimConfig] = None,
+                   replica_counts: Sequence[int] = (1, 2, 4),
+                   slo_ttft_p90_ms: Optional[float] = None) -> Dict:
+    """Simulate ``trace`` at each fleet size; when an SLO is given, also
+    report the smallest fleet meeting it (None if none does)."""
+    base = base or SimConfig()
+    rows = []
+    for n in replica_counts:
+        cfg = copy.deepcopy(base)
+        cfg.replicas = int(n)
+        cfg.roles = None           # capacity sweeps are role-uniform
+        res = FleetSimulator(cfg).run(trace)
+        row = {"replicas": int(n), **_result_row(res)}
+        if slo_ttft_p90_ms is not None:
+            row["meets_slo"] = (
+                row["completed"] == len(trace)
+                and row["ttft_p90_ms"] is not None
+                and row["ttft_p90_ms"] <= slo_ttft_p90_ms)
+        rows.append(row)
+    out = {"requests": len(trace), "rows": rows}
+    if slo_ttft_p90_ms is not None:
+        fit = [r["replicas"] for r in rows if r.get("meets_slo")]
+        out["slo_ttft_p90_ms"] = slo_ttft_p90_ms
+        out["min_replicas_for_slo"] = min(fit) if fit else None
+    return out
+
+
+def _candidates(space: Dict[str, Sequence], mode: str, samples: int,
+                seed: int) -> List[Dict]:
+    keys = sorted(space)
+    if mode == "grid":
+        return [dict(zip(keys, combo))
+                for combo in itertools.product(*(space[k] for k in keys))]
+    if mode != "random":
+        raise ValueError(f"mode={mode!r}: expected 'grid' or 'random'")
+    rng = random.Random(seed)
+    seen, out = set(), []
+    for _ in range(samples * 20):
+        combo = tuple(rng.choice(list(space[k])) for k in keys)
+        if combo in seen:
+            continue
+        seen.add(combo)
+        out.append(dict(zip(keys, combo)))
+        if len(out) >= samples:
+            break
+    return out
+
+
+def serve_config_from(cfg: SimConfig, knobs: Dict, row: Dict,
+                      score: float) -> Dict:
+    """The deployable artifact: the JSON shape ``bin/dstpu_serve
+    --config`` overlays onto its engine/scheduler/edge construction."""
+    e = cfg.engine or RaggedInferenceEngineConfig()
+    s = cfg.scheduler
+    ec = cfg.edge
+    return {
+        "version": SERVE_CONFIG_VERSION,
+        "knobs": dict(knobs),
+        "engine": {
+            "frame_steps": e.frame_steps,
+            "prefill_chunk_size": e.prefill_chunk_size,
+            "speculate_gamma": e.speculate_gamma,
+            "prefix_cache": e.prefix_cache,
+            "prefix_cache_max_blocks": e.prefix_cache_max_blocks,
+            "max_ragged_batch_size": e.max_ragged_batch_size,
+        },
+        "speculate": cfg.speculate,
+        "scheduler": {
+            "lookahead_reserve": bool(s.lookahead_reserve) if s else False,
+        },
+        "edge": {
+            "max_queued_tokens": ec.max_queued_tokens if ec else None,
+            "shed_score": ec.shed_score if ec else None,
+        },
+        "predicted": row,
+        "score": round(score, 3),
+    }
+
+
+def tune(trace: List[Dict], base: Optional[SimConfig] = None,
+         space: Optional[Dict[str, Sequence]] = None, mode: str = "random",
+         samples: int = 24, seed: int = 0,
+         score_fn=None) -> Tuple[Dict, List[Dict]]:
+    """Search the knob space against ``trace``. Returns ``(serve_config,
+    rows)``: the winner as a deployable serve-config blob, and every
+    candidate's scored row (ranked best-first) for the frontier table."""
+    base = base or SimConfig()
+    space = space or DEFAULT_SPACE
+    score_fn = score_fn or default_score
+    rows = []
+    best = None                    # (score, knob-repr, cfg, knobs, row)
+    for knobs in _candidates(space, mode, samples, seed):
+        cfg = apply_knobs(base, knobs)
+        res = FleetSimulator(cfg).run(trace)
+        sc = score_fn(res, len(trace))
+        row = {"knobs": dict(knobs), "score": round(sc, 3),
+               **_result_row(res)}
+        rows.append(row)
+        key = (sc, repr(sorted(knobs.items())))
+        if best is None or key < best[0]:
+            best = (key, cfg, knobs, row)
+    rows.sort(key=lambda r: (r["score"], repr(sorted(r["knobs"].items()))))
+    _, cfg, knobs, row = best
+    return serve_config_from(cfg, knobs, row, row["score"]), rows

@@ -542,3 +542,66 @@ class FlightRecorder:
             lines.append("# TYPE ds_flight_ring_size gauge")
             lines.append(f"ds_flight_ring_size {len(self.events)}")
             return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------------
+# workload extraction (the trace -> simulator replay surface)
+# ----------------------------------------------------------------------
+
+# root-span attrs that ARE the replayable workload identity of a request
+# (stamped at the edge's mint; see service/edge.py). Everything else on
+# the span tree is execution history, not workload.
+WORKLOAD_ATTRS = ("prompt_tokens", "max_new_tokens", "tenant", "priority",
+                  "slo_ms", "session", "deadline_ms")
+
+
+def extract_workload(spans_by_trace: Dict[str, List[Dict]]) -> List[Dict]:
+    """Extract a replayable ARRIVAL TRACE from exported spans.
+
+    ``spans_by_trace`` maps trace id -> span dicts (the ``export_jsonl``
+    / ``export_chrome`` record shape; ``bin/dstpu_trace``'s
+    ``load_spans`` parses both back to exactly this). Each trace's ROOT
+    span (sid ``s0``) was minted the instant the edge/router accepted
+    the request, and its attrs carry the workload identity
+    (``WORKLOAD_ATTRS``): the result is one arrival event per trace —
+
+        {"t": <seconds from the first arrival>, "uid", "prompt_tokens",
+         "max_new_tokens"?, "tenant"?, "priority"?, "slo_ms"?,
+         "session"?, "deadline_ms"?}
+
+    sorted by (t, uid) — the ``sim.traffic`` trace format the fleet
+    simulator replays (and ``save_trace``/``load_trace`` round-trip).
+    Traces without a root span or a uid are skipped (a trailing partial
+    export), as are roots predating the metadata stamp with no
+    ``prompt_tokens`` — those cannot be replayed faithfully and a
+    silently guessed prompt length would be fiction, not observability.
+    Returns [] for an empty export."""
+    events: List[Dict] = []
+    skipped = 0
+    for tid, spans in spans_by_trace.items():
+        root = next((s for s in spans
+                     if s.get("sid") == "s0" or s.get("parent") is None),
+                    None)
+        if root is None:
+            skipped += 1
+            continue
+        attrs = root.get("attrs") or {}
+        uid = attrs.get("uid")
+        if uid is None or attrs.get("prompt_tokens") is None:
+            skipped += 1
+            continue
+        ev = {"t": float(root["t0"]), "uid": int(uid), "trace_id": tid}
+        for k in WORKLOAD_ATTRS:
+            if attrs.get(k) is not None:
+                ev[k] = attrs[k]
+        events.append(ev)
+    if skipped:
+        logger.warning(f"extract_workload: skipped {skipped} trace(s) "
+                       "without a root span / uid / prompt_tokens "
+                       "(pre-metadata exports are not replayable)")
+    events.sort(key=lambda e: (e["t"], e["uid"]))
+    if events:
+        t0 = events[0]["t"]
+        for ev in events:
+            ev["t"] = round(ev["t"] - t0, 9)
+    return events
